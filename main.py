@@ -1,0 +1,5 @@
+"""Repo-root CLI entry, drop-in for the reference's ``python main.py ...``."""
+from video_features_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Must run before the first `import jax` anywhere in the test process, so the
+env vars are set at conftest import time. Multi-chip sharding is validated on
+this virtual mesh (no multi-chip TPU hardware in CI); the single real TPU chip
+is exercised by bench.py instead.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Hard-pin the CPU backend: site customizations on some hosts re-point
+# jax_platforms at an accelerator plugin after env vars are read, so the env
+# var alone is not enough. Tests must never claim the real TPU chip.
+jax.config.update("jax_platforms", "cpu")
+
+# full-fp32 conv/matmul accumulation: parity tests compare against torch CPU
+jax.config.update("jax_default_matmul_precision", "highest")
+
+REFERENCE_ROOT = "/root/reference"
+SAMPLE_VIDEO = os.path.join(REFERENCE_ROOT, "sample", "v_GGSY1Qvo990.mp4")
+
+
+@pytest.fixture(scope="session")
+def sample_video():
+    if not os.path.exists(SAMPLE_VIDEO):
+        pytest.skip("reference sample video not available")
+    return SAMPLE_VIDEO
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,88 @@
+"""Config system: YAML defaults, dotlist overrides, sanity_check semantics."""
+import os
+
+import pytest
+
+from video_features_tpu.config import (Config, load_config, merge,
+                                       parse_dotlist, sanity_check)
+
+
+def test_dotlist_parsing_types():
+    cfg = parse_dotlist([
+        "feature_type=resnet", "batch_size=16", "extraction_fps=null",
+        "video_paths=[a.mp4,b.mp4]", "show_pred=true", "a.b=1",
+    ])
+    assert cfg.feature_type == "resnet"
+    assert cfg.batch_size == 16
+    assert cfg.extraction_fps is None
+    assert cfg.video_paths == ["a.mp4", "b.mp4"]
+    assert cfg.show_pred is True
+    assert cfg.a.b == 1
+
+
+def test_yaml_defaults_merged_under_cli():
+    cfg = load_config("resnet", parse_dotlist(["batch_size=32"]))
+    assert cfg.batch_size == 32            # CLI wins
+    assert cfg.model_name == "resnet50"    # YAML default survives
+
+
+def test_all_families_have_configs():
+    for ft in ("i3d", "r21d", "s3d", "vggish", "resnet", "raft", "pwc", "clip"):
+        cfg = load_config(ft)
+        assert cfg.feature_type == ft
+        assert "output_path" in cfg and "tmp_path" in cfg
+
+
+def test_sanity_check_namespaces_output_paths(tmp_path):
+    cfg = load_config("resnet", {
+        "video_paths": "x.mp4", "device": "cpu",
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    # feature_type/model_name appended (reference utils/utils.py:112-125)
+    assert cfg.output_path.endswith(os.path.join("out", "resnet", "resnet50"))
+    assert cfg.tmp_path.endswith(os.path.join("tmp", "resnet", "resnet50"))
+
+
+def test_sanity_check_slash_in_model_name(tmp_path):
+    cfg = load_config("clip", {
+        "video_paths": "x.mp4", "device": "cpu",
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    assert cfg.output_path.endswith(os.path.join("clip", "ViT-B_32"))
+
+
+def test_sanity_check_rejects_duplicate_stems(tmp_path):
+    cfg = load_config("resnet", {
+        "video_paths": ["a/v.mp4", "b/v.mp4"], "device": "cpu",
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    with pytest.raises(AssertionError):
+        sanity_check(cfg)
+
+
+def test_sanity_check_fps_total_exclusive(tmp_path):
+    cfg = load_config("resnet", {
+        "video_paths": "x.mp4", "device": "cpu", "extraction_fps": 5,
+        "extraction_total": 10,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    with pytest.raises(AssertionError):
+        sanity_check(cfg)
+
+
+def test_sanity_check_i3d_stack_size(tmp_path):
+    cfg = load_config("i3d", {
+        "video_paths": "x.mp4", "device": "cpu", "stack_size": 5,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    with pytest.raises(AssertionError):
+        sanity_check(cfg)
+
+
+def test_merge_deep():
+    a = Config({"x": {"y": 1, "z": 2}, "k": 0})
+    b = Config({"x": {"y": 5}})
+    m = merge(a, b)
+    assert m.x.y == 5 and m.x.z == 2 and m.k == 0

@@ -1,0 +1,111 @@
+"""VideoSource: batching, overlap, fps resampling, timestamp contract."""
+import numpy as np
+import pytest
+
+from video_features_tpu.utils.io import (VideoSource, fps_filter_map,
+                                         get_video_props, read_video_frames)
+from video_features_tpu.utils.lists import form_slices
+
+
+def test_video_props(sample_video):
+    props = get_video_props(sample_video)
+    assert props["num_frames"] == 355
+    assert props["height"] == 240 and props["width"] == 320
+    assert abs(props["fps"] - 19.62) < 0.01
+
+
+def test_native_fps_iteration(sample_video):
+    src = VideoSource(sample_video, batch_size=64)
+    total, first_ts = 0, None
+    for batch, times, indices in src:
+        assert len(batch) == len(times) == len(indices)
+        assert len(batch) <= 64
+        if first_ts is None:
+            first_ts = times[0]
+            assert indices[0] == 0
+        total += len(batch)
+    assert first_ts == 0.0
+    assert total == len(src) == 355
+
+
+def test_timestamps_are_index_over_fps(sample_video):
+    src = VideoSource(sample_video, batch_size=16)
+    for batch, times, indices in src:
+        for t, i in zip(times, indices):
+            assert t == pytest.approx(i / src.fps * 1000.0)
+        break
+
+
+def test_overlap_carries_frames(sample_video):
+    src = VideoSource(sample_video, batch_size=8, overlap=1)
+    batches = list(src)
+    # first batch: 8 new; later: 1 carried + 7 new
+    assert batches[0][2][0] == 0
+    for prev, cur in zip(batches, batches[1:]):
+        assert cur[2][0] == prev[2][-1]  # first index of batch = last of prev
+    # every frame consumed exactly once beyond the overlap duplicates
+    all_idx = [i for _, _, idx in batches for i in idx]
+    uniq = sorted(set(all_idx))
+    assert uniq == list(range(355))
+
+
+def test_fps_resampling_count_and_fps(sample_video):
+    src = VideoSource(sample_video, batch_size=4, fps=1)
+    assert src.fps == 1.0
+    n = sum(len(b) for b, _, _ in src)
+    # 355 frames @19.62fps = ~18.1s -> 18 or 19 one-fps frames
+    assert n == len(src)
+    assert 17 <= n <= 19
+
+
+def test_total_resampling(sample_video):
+    src = VideoSource(sample_video, batch_size=4, total=10)
+    n = sum(len(b) for b, _, _ in src)
+    assert n <= 10
+    assert n >= 9
+
+
+def test_fps_and_total_exclusive(sample_video):
+    with pytest.raises(ValueError):
+        VideoSource(sample_video, fps=5, total=10)
+
+
+def test_fps_filter_map_properties():
+    # downsample 100 frames 30->10 fps: every 3rd frame (the last of the
+    # input frames rounding into each output slot wins, as in ffmpeg's
+    # fps filter), monotonic
+    m = fps_filter_map(100, 30.0, 10.0)
+    assert np.array_equal(m[:-1], 3 * np.arange(len(m) - 1) + 1)
+    assert m[-1] == 99  # input ends before the final slot's preferred frame
+    assert np.all(np.diff(m) >= 0)
+    assert len(m) == pytest.approx(34, abs=1)
+    # upsample duplicates frames
+    m2 = fps_filter_map(10, 10.0, 20.0)
+    assert len(m2) == pytest.approx(19, abs=1)
+    assert np.all(np.diff(m2) <= 1)
+    # identity
+    m3 = fps_filter_map(50, 25.0, 25.0)
+    assert np.array_equal(m3, np.arange(50))
+
+
+def test_read_video_frames_shape(sample_video):
+    frames, fps = read_video_frames(sample_video)
+    assert frames.shape == (355, 240, 320, 3)
+    assert frames.dtype == np.uint8
+    assert abs(fps - 19.62) < 0.01
+
+
+def test_transform_applied(sample_video):
+    src = VideoSource(sample_video, batch_size=2,
+                      transform=lambda x: x[:10, :12].astype(np.float32))
+    batch, _, _ = next(iter(src))
+    assert batch[0].shape == (10, 12, 3)
+    assert batch[0].dtype == np.float32
+
+
+def test_form_slices_drops_partial_tail():
+    # reference utils/utils.py:59-68 contract
+    assert form_slices(100, 15, 15) == [(0, 15), (15, 30), (30, 45), (45, 60),
+                                        (60, 75), (75, 90)]
+    assert form_slices(10, 4, 2) == [(0, 4), (2, 6), (4, 8), (6, 10)]
+    assert form_slices(3, 4, 2) == []

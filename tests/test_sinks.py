@@ -1,0 +1,52 @@
+"""Output sinks: file naming, idempotent skip, corruption re-extraction."""
+import numpy as np
+
+from video_features_tpu.utils import sinks
+
+
+def test_make_path_contract(tmp_path):
+    p = sinks.make_path(str(tmp_path), "/videos/v_abc.mp4", "resnet", ".npy")
+    assert p.endswith("v_abc_resnet.npy")
+
+
+def test_save_and_skip_numpy(tmp_path):
+    feats = {"resnet": np.ones((3, 4)), "fps": np.array(25.0),
+             "timestamps_ms": np.array([0.0, 40.0, 80.0])}
+    keys = list(feats)
+    video = "/videos/clip.mp4"
+    assert not sinks.is_already_exist("save_numpy", str(tmp_path), video, keys)
+    sinks.action_on_extraction(feats, video, str(tmp_path), "save_numpy")
+    assert sinks.is_already_exist("save_numpy", str(tmp_path), video, keys)
+    loaded = sinks.load_numpy(sinks.make_path(str(tmp_path), video, "resnet", ".npy"))
+    np.testing.assert_array_equal(loaded, feats["resnet"])
+
+
+def test_save_and_skip_pickle(tmp_path):
+    feats = {"clip": np.zeros((2, 512))}
+    video = "v.mp4"
+    sinks.action_on_extraction(feats, video, str(tmp_path), "save_pickle")
+    assert sinks.is_already_exist("save_pickle", str(tmp_path), video, ["clip"])
+
+
+def test_corrupt_file_triggers_reextraction(tmp_path):
+    video = "v.mp4"
+    keys = ["feat"]
+    fpath = sinks.make_path(str(tmp_path), video, "feat", ".npy")
+    with open(fpath, "wb") as f:
+        f.write(b"not-a-npy")  # partial write from a preempted worker
+    assert not sinks.is_already_exist("save_numpy", str(tmp_path), video, keys)
+
+
+def test_print_sink_never_skips(tmp_path):
+    assert not sinks.is_already_exist("print", str(tmp_path), "v.mp4", ["x"])
+
+
+def test_safe_extract_isolates_errors():
+    calls = []
+
+    def bad(path):
+        calls.append(path)
+        raise RuntimeError("decode failed")
+
+    assert sinks.safe_extract(bad, "v.mp4") is False
+    assert calls == ["v.mp4"]

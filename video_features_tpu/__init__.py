@@ -1,0 +1,28 @@
+"""TPU-native video feature extraction framework.
+
+A from-scratch JAX/XLA/Flax/Pallas re-design of the capabilities of
+``video_features`` (reference mounted at /root/reference): given video files,
+extract per-video feature arrays with eight pretrained model families
+(R(2+1)D, I3D RGB+Flow, S3D, ResNet, CLIP, VGGish, RAFT, PWC-Net).
+
+Compute path: jit-compiled Flax modules with static-shape, shape-bucketed clip
+batches, sharded over a `jax.sharding.Mesh` (ICI data-parallel; multi-host via
+deterministic video->host assignment). Iterative correlation volumes (RAFT/PWC)
+use Pallas TPU kernels. The host side (decode, windowing, sinks) streams
+fixed-shape batches into the device pipeline.
+
+CLI and output contracts mirror the reference:
+  - ``python main.py feature_type=r21d video_paths=...`` dotlist interface
+    (reference main.py:7-51)
+  - per-video outputs named ``{stem}_{key}.npy`` / ``.pkl``
+    (reference utils/utils.py:53-57)
+  - idempotent skip-if-exists with load-validation corruption check
+    (reference models/_base/base_extractor.py:95-127)
+"""
+
+__version__ = "0.1.0"
+
+SUPPORTED_FEATURE_TYPES = (
+    "i3d", "r21d", "s3d", "vggish",
+    "resnet", "raft", "pwc", "clip",
+)

@@ -1,0 +1,50 @@
+"""CLI driver: ``python main.py feature_type=X key=val ...``.
+
+Same surface as reference main.py:7-51: per-feature YAML defaults merged under
+CLI dotlist overrides, validated, then a progress-bar loop over the (shuffled)
+video list with per-video error isolation. Multi-host runs additionally filter
+the list to this host's deterministic shard (parallel/mesh.py).
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tqdm import tqdm
+
+from .config import load_config, parse_dotlist, sanity_check
+from .registry import get_extractor_cls
+from .utils.lists import form_list_from_user_input
+from .utils.sinks import safe_extract
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cli_args = parse_dotlist(argv)
+    if "feature_type" not in cli_args:
+        raise SystemExit("Usage: main.py feature_type=<family> [key=value ...]")
+    args = load_config(cli_args.feature_type, cli_args)
+    sanity_check(args)
+    verbose = args.get("on_extraction", "print") == "print"
+    if verbose:
+        print(args.to_yaml())
+
+    extractor = get_extractor_cls(args.feature_type)(args)
+
+    video_paths = form_list_from_user_input(
+        args.get("video_paths"), args.get("file_with_video_paths"),
+        to_shuffle=True)
+    # multi-host: keep only this host's deterministic shard of the work list
+    # (jax.process_count() is 1 when jax.distributed is not initialized)
+    from .parallel.mesh import local_shard_of_list
+    video_paths = local_shard_of_list(video_paths)
+
+    for video_path in tqdm(video_paths):
+        safe_extract(extractor._extract, video_path)
+
+    if verbose:
+        print(f"Yay! Done! The results are in {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,230 @@
+"""Config system: per-feature YAML defaults + CLI dotlist overrides.
+
+Re-designed equivalent of the reference's OmegaConf flow (reference
+main.py:9-10, utils/utils.py:71-125,218-229) without the OmegaConf dependency:
+plain-YAML defaults in ``video_features_tpu/configs/<feature_type>.yml`` merged
+under a parsed ``key=value`` dotlist (CLI wins), then validated and
+path-patched by :func:`sanity_check`.
+
+Differences from the reference, by design:
+  - ``device`` is ``tpu`` / ``cpu`` / ``auto`` (default). ``cuda*`` values are
+    accepted for drop-in compatibility and mapped to ``auto`` with a warning
+    (the reference falls back cuda->cpu at utils/utils.py:84-86).
+  - PWC-Net runs everywhere (the reference requires a GPU,
+    utils/utils.py:104-105, because its correlation is a CuPy CUDA kernel; ours
+    is a Pallas/XLA kernel with a pure-XLA interpret path on CPU).
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import yaml
+
+_CONFIG_DIR = Path(__file__).resolve().parent / "configs"
+
+
+class Config(dict):
+    """A dict with attribute access, nesting-aware, YAML-serializable.
+
+    Stands in for OmegaConf's DictConfig in the reference API surface.
+    """
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        try:
+            del self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    @staticmethod
+    def _wrap(value: Any) -> Any:
+        if isinstance(value, dict) and not isinstance(value, Config):
+            return Config({k: Config._wrap(v) for k, v in value.items()})
+        if isinstance(value, list):
+            return [Config._wrap(v) for v in value]
+        return value
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            super().__setitem__(k, Config._wrap(v))
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, Config._wrap(value))
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(_plain(self), sort_keys=False)
+
+
+def _plain(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _plain(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_plain(v) for v in obj]
+    return obj
+
+
+def build_cfg_path(feature_type: str) -> Path:
+    """Path of the YAML defaults for a feature family.
+
+    Mirrors reference utils/utils.py:218-229 but resolves inside the installed
+    package instead of the current working directory.
+    """
+    path = _CONFIG_DIR / f"{feature_type}.yml"
+    return path
+
+
+def load_yaml(path: Union[str, os.PathLike]) -> Config:
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    return Config(data)
+
+
+def parse_dotlist(argv: Sequence[str]) -> Config:
+    """Parse ``key=value`` CLI arguments (OmegaConf.from_cli equivalent).
+
+    Values go through YAML, so ``batch_size=16`` is an int, ``flow_type=null``
+    is None, ``video_paths=[a.mp4,b.mp4]`` is a list. Dots nest:
+    ``a.b=1`` -> ``{'a': {'b': 1}}``.
+    """
+    out: Dict[str, Any] = {}
+    for arg in argv:
+        if "=" not in arg:
+            raise ValueError(
+                f"CLI arguments must look like key=value (got {arg!r})")
+        key, raw = arg.split("=", 1)
+        try:
+            value = yaml.safe_load(raw) if raw != "" else None
+        except yaml.YAMLError:
+            value = raw
+        node = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return Config(out)
+
+
+def merge(base: Config, override: Config) -> Config:
+    """Deep merge; ``override`` wins (OmegaConf.merge semantics we rely on)."""
+    result = Config(dict(base))
+    for k, v in override.items():
+        if k in result and isinstance(result[k], dict) and isinstance(v, dict):
+            result[k] = merge(result[k], v)
+        else:
+            result[k] = v
+    return result
+
+
+def load_config(feature_type: str,
+                overrides: Optional[Union[Config, Dict[str, Any]]] = None,
+                ) -> Config:
+    """YAML defaults for ``feature_type`` merged under ``overrides``."""
+    cfg_path = build_cfg_path(feature_type)
+    if not cfg_path.exists():
+        raise FileNotFoundError(
+            f"Unknown feature_type {feature_type!r}: no config at {cfg_path}")
+    cfg = load_yaml(cfg_path)
+    if overrides:
+        cfg = merge(cfg, Config(dict(overrides)))
+    return cfg
+
+
+def resolve_device(device: Optional[str]) -> str:
+    """Map a user device string to 'tpu' or 'cpu'.
+
+    Accepts 'auto' (default), 'tpu', 'cpu', and legacy 'cuda*' strings, which
+    are treated as 'auto' for drop-in compatibility with reference configs.
+    """
+    if device is None:
+        device = "auto"
+    device = str(device)
+    if device.startswith("cuda"):
+        print(f"device={device!r} is a CUDA ordinal from the reference CLI; "
+              "this framework targets TPU. Treating it as device=auto.")
+        device = "auto"
+    if device in ("tpu", "cpu"):
+        # never touch jax.devices() for an explicit choice: initializing the
+        # accelerator plugin claims the chip, which `device=cpu` must not do
+        return device
+    if device != "auto":
+        raise ValueError(f"Unsupported device {device!r}; use tpu|cpu|auto")
+    import jax
+    platforms = {d.platform for d in jax.devices()}
+    return "tpu" if "tpu" in platforms else "cpu"
+
+
+def sanity_check(args: Config) -> None:
+    """Validate user arguments and patch output/tmp paths in place.
+
+    Reproduces the semantics of reference utils/utils.py:71-125:
+      - one of video_paths / file_with_video_paths required
+      - unique video stems (the output filename contract collides otherwise)
+      - output_path != tmp_path
+      - i3d stack_size >= 10
+      - batch_size must not be None when present
+      - extraction_fps / extraction_total mutually exclusive
+      - output_path & tmp_path get ``feature_type[/model_name]`` appended with
+        '/' replaced by '_' (e.g. CLIP's ViT-B/32 -> ViT-B_32)
+
+    Dropped on purpose: the cuda->cpu fallback (resolve_device handles device
+    naming) and the PWC-needs-GPU assert (our PWC correlation is Pallas/XLA).
+    """
+    from .utils.lists import form_list_from_user_input
+
+    if "device_ids" in args:
+        print("WARNING: `device_ids` is a removed reference flag; single-host "
+              "multi-chip execution here is automatic over the TPU mesh. "
+              "Ignoring it.")
+        del args["device_ids"]
+    args.device = resolve_device(args.get("device"))
+
+    assert args.get("file_with_video_paths") or args.get("video_paths"), \
+        "`video_paths` or `file_with_video_paths` must be specified"
+    filenames = [Path(p).stem for p in form_list_from_user_input(
+        args.get("video_paths"), args.get("file_with_video_paths"),
+        to_shuffle=False)]
+    assert len(filenames) == len(set(filenames)), \
+        "Non-unique video file stems: outputs would overwrite each other " \
+        "(same contract as reference video_features issue #54)"
+    assert os.path.relpath(str(args.output_path)) != os.path.relpath(str(args.tmp_path)), \
+        "The same path for out & tmp"
+
+    if args.get("show_pred") and args.feature_type == "vggish":
+        print("Showing class predictions is not implemented for VGGish")
+
+    if args.feature_type == "i3d" and args.get("stack_size") is not None:
+        assert args.stack_size >= 10, (
+            "I3D model does not support inputs shorter than 10 timestamps. "
+            f"You have: {args.stack_size}")
+
+    if "batch_size" in args:
+        assert args.batch_size is not None, \
+            f"Please specify `batch_size`. It is {args.batch_size} now"
+
+    if "extraction_fps" in args and "extraction_total" in args:
+        assert not (args.get("extraction_fps") is not None
+                    and args.get("extraction_total") is not None), \
+            "`extraction_fps` and `extraction_total` are mutually exclusive"
+
+    # Namespace outputs under feature_type[/model_name], '/'->'_'
+    # (reference utils/utils.py:112-125).
+    subs: List[str] = [args.feature_type]
+    if "model_name" in args and args.model_name is not None:
+        subs.append(str(args.model_name))
+    out, tmp = str(args.output_path), str(args.tmp_path)
+    for p in subs:
+        out = os.path.join(out, p.replace("/", "_"))
+        tmp = os.path.join(tmp, p.replace("/", "_"))
+    args.output_path = out
+    args.tmp_path = tmp

@@ -1,0 +1,4 @@
+"""Extractor layer: per-family orchestration (load weights, window the video,
+run the jitted forward, collect features). Mirrors the reference's L3
+(reference models/*/extract_*.py + models/_base/) re-designed around
+static-shape jitted device steps."""

@@ -1,0 +1,62 @@
+"""Extraction lifecycle shared by all families.
+
+Mirrors reference models/_base/base_extractor.py:11-127:
+``_extract`` = skip-if-exists -> ``extract`` -> sink dispatch, with per-video
+error isolation handled by the caller via ``utils.sinks.safe_extract``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..utils import sinks
+
+
+class BaseExtractor:
+    output_feat_keys: List[str]
+
+    def __init__(self, args: Config) -> None:
+        self.feature_type = args.feature_type
+        self.on_extraction = args.get("on_extraction", "print")
+        self.tmp_path = str(args.tmp_path)
+        self.output_path = str(args.output_path)
+        self.keep_tmp_files = bool(args.get("keep_tmp_files", False))
+        self.device = args.get("device", "auto")
+        self.precision = args.get("precision", "float32")
+        import jax
+        if self.device == "cpu":
+            # hard-pin: site customizations may force the accelerator plugin
+            # into jax_platforms after env vars are read; an explicit cpu run
+            # must never initialize (and thereby claim) the TPU
+            jax.config.update("jax_platforms", "cpu")
+        if self.precision == "float32":
+            # full-fp32 accumulation for parity with the torch reference;
+            # 'bfloat16' mode keeps the MXU-native fast path instead
+            jax.config.update("jax_default_matmul_precision", "highest")
+        self.show_pred = bool(args.get("show_pred", False))
+        self.args = args
+
+    # -- lifecycle ---------------------------------------------------------
+    def _extract(self, video_path: str) -> Optional[Dict[str, np.ndarray]]:
+        if sinks.is_already_exist(self.on_extraction, self.output_path,
+                                  video_path, self.output_feat_keys):
+            return None
+        feats = self.extract(video_path)
+        self.action_on_extraction(feats, video_path)
+        return feats
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def action_on_extraction(self, feats: Dict[str, np.ndarray],
+                             video_path: str) -> None:
+        # re-check before overwrite: another worker may have just written it
+        # (reference base_extractor.py:72-76)
+        if self.on_extraction != "print" and sinks.is_already_exist(
+                self.on_extraction, self.output_path, video_path,
+                self.output_feat_keys):
+            return
+        sinks.action_on_extraction(feats, video_path, self.output_path,
+                                   self.on_extraction)

@@ -1,0 +1,71 @@
+"""ResNet frame-wise extractor.
+
+Parity target: reference models/resnet/extract_resnet.py (Resize 256 ->
+CenterCrop 224 -> ToTensor -> ImageNet Normalize; fc swapped for Identity with
+the classifier kept for show_pred). Output keys: ['resnet', 'fps',
+'timestamps_ms'] (reference base_framewise_extractor.py:44).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models import resnet as resnet_model
+from ..ops import preprocess as pp
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..utils.labels import show_predictions_on_dataset
+from ..weights import store
+from .frame_wise import FrameWiseExtractor
+
+
+def _device_forward(model: resnet_model.ResNet, dtype, params, batch_u8):
+    """uint8 (B,224,224,3) -> (B,D): /255, ImageNet-normalize, backbone."""
+    x = batch_u8.astype(jnp.float32) / 255.0
+    x = (x - jnp.asarray(pp.IMAGENET_MEAN)) / jnp.asarray(pp.IMAGENET_STD)
+    x = x.astype(dtype)
+    return model.apply({"params": params}, x).astype(jnp.float32)
+
+
+class ExtractResNet(FrameWiseExtractor):
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args)
+        if self.model_name not in resnet_model.VARIANTS:
+            raise NotImplementedError(f"Model {self.model_name} not found.")
+        self.model = resnet_model.ResNet(self.model_name)
+        self.head = resnet_model.Classifier()
+
+        def init_fn():
+            import jax
+            variables = self.model.init(jax.random.PRNGKey(0),
+                                        jnp.zeros((1, 224, 224, 3)))
+            head_vars = self.head.init(jax.random.PRNGKey(1),
+                                       jnp.zeros((1, resnet_model.FEATURE_DIMS[self.model_name])))
+            return {"backbone": variables["params"], "head": head_vars["params"]}
+
+        params = store.resolve_params(
+            self.model_name, init_fn, resnet_model.params_from_torch,
+            weights_path=args.get("weights_path"),
+            allow_random=bool(args.get("allow_random_weights", False)))
+        self.head_params = params["head"]
+
+        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_device_forward, self.model, dtype),
+            params["backbone"], mesh=mesh)
+
+        def transform(rgb: np.ndarray) -> np.ndarray:
+            out = pp.pil_resize(rgb, 256, interpolation="bilinear")
+            return pp.center_crop(out, 224)
+
+        self.host_transform = transform
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        if self.show_pred:
+            logits = self.head.apply({"params": self.head_params},
+                                     jnp.asarray(feats))
+            show_predictions_on_dataset(np.asarray(logits), "imagenet")

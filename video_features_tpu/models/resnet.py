@@ -1,0 +1,166 @@
+"""ResNet-18/34/50/101/152 (torchvision v1.5 layout) as Flax modules, NHWC.
+
+The reference pulls these from torchvision at runtime
+(reference models/resnet/extract_resnet.py:46-51) and swaps ``fc`` for
+Identity, keeping the classifier separately for ``show_pred``. Here the
+backbone is a Flax module returning pooled 512/2048-d features; the classifier
+is an optional separate head applied only for show_pred.
+
+Weight transplant: :func:`params_from_torch` maps a torchvision
+``resnet*`` state_dict onto this tree (OIHW->HWIO etc.).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .common import BNInf, max_pool_same_torch
+from ..weights import torch_import as ti
+
+# stage block counts and block type per variant
+VARIANTS = {
+    "resnet18": ((2, 2, 2, 2), "basic"),
+    "resnet34": ((3, 4, 6, 3), "basic"),
+    "resnet50": ((3, 4, 6, 3), "bottleneck"),
+    "resnet101": ((3, 4, 23, 3), "bottleneck"),
+    "resnet152": ((3, 8, 36, 3), "bottleneck"),
+}
+
+FEATURE_DIMS = {"resnet18": 512, "resnet34": 512, "resnet50": 2048,
+                "resnet101": 2048, "resnet152": 2048}
+
+
+def _conv(features: int, kernel: int, stride: int = 1, pad: int = 0,
+          name: str = None) -> nn.Conv:
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                   padding=[(pad, pad), (pad, pad)], use_bias=False, name=name)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = _conv(self.planes, 3, self.stride, 1, "conv1")(x)
+        out = BNInf(name="bn1")(out)
+        out = nn.relu(out)
+        out = _conv(self.planes, 3, 1, 1, "conv2")(out)
+        out = BNInf(name="bn2")(out)
+        if self.has_downsample:
+            identity = _conv(self.planes, 1, self.stride, 0, "downsample_conv")(x)
+            identity = BNInf(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = _conv(self.planes, 1, 1, 0, "conv1")(x)
+        out = BNInf(name="bn1")(out)
+        out = nn.relu(out)
+        # torchvision puts the stride on the 3x3 (the "v1.5" variant)
+        out = _conv(self.planes, 3, self.stride, 1, "conv2")(out)
+        out = BNInf(name="bn2")(out)
+        out = nn.relu(out)
+        out = _conv(self.planes * self.expansion, 1, 1, 0, "conv3")(out)
+        out = BNInf(name="bn3")(out)
+        if self.has_downsample:
+            identity = _conv(self.planes * self.expansion, 1, self.stride, 0,
+                             "downsample_conv")(x)
+            identity = BNInf(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    """Backbone forward: (N, H, W, 3) float in [0,1]-normalized space -> (N, D)."""
+    variant: str = "resnet50"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        stages, block_kind = VARIANTS[self.variant]
+        block_cls = BasicBlock if block_kind == "basic" else Bottleneck
+        expansion = 1 if block_kind == "basic" else 4
+
+        x = _conv(64, 7, 2, 3, "conv1")(x)
+        x = BNInf(name="bn1")(x)
+        x = nn.relu(x)
+        x = max_pool_same_torch(x, (3, 3), (2, 2), ((1, 1), (1, 1)))
+
+        in_planes = 64
+        for stage_idx, num_blocks in enumerate(stages):
+            planes = 64 * (2 ** stage_idx)
+            stride = 1 if stage_idx == 0 else 2
+            for block_idx in range(num_blocks):
+                s = stride if block_idx == 0 else 1
+                needs_ds = (s != 1) or (in_planes != planes * expansion)
+                x = block_cls(planes, s, needs_ds,
+                              name=f"layer{stage_idx + 1}_{block_idx}")(x)
+                in_planes = planes * expansion
+
+        # global average pool (torch AdaptiveAvgPool2d(1))
+        return jnp.mean(x, axis=(1, 2))
+
+
+class Classifier(nn.Module):
+    """The fc head the reference keeps aside as `class_head`
+    (reference extract_resnet.py:54-56)."""
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """torchvision resnet state_dict -> {'backbone': ..., 'head': ...} trees."""
+    backbone: Dict[str, Any] = {}
+    head: Dict[str, Any] = {}
+    for key, tensor in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        if parts[0] == "fc":
+            if parts[1] == "weight":
+                ti.set_in(head, "fc/kernel", ti.linear_kernel(tensor))
+            else:
+                ti.set_in(head, "fc/bias", ti.to_np(tensor))
+            continue
+        if parts[0].startswith("layer"):
+            # layer1.0.conv1.weight -> layer1_0/conv1/kernel
+            block = f"{parts[0]}_{parts[1]}"
+            rest = parts[2:]
+            if rest[0] == "downsample":
+                sub = "downsample_conv" if rest[1] == "0" else "downsample_bn"
+                rest = [sub] + rest[2:]
+            path = [block] + rest
+        else:
+            path = parts
+        _assign_leaf(backbone, path, tensor)
+    return {"backbone": backbone, "head": head}
+
+
+_BN_LEAF = {"weight": "scale", "bias": "bias",
+            "running_mean": "mean", "running_var": "var"}
+
+
+def _assign_leaf(tree: Dict[str, Any], path: Sequence[str], tensor) -> None:
+    *prefix, module, leaf = path
+    if module.startswith("bn") or module.endswith("_bn"):
+        ti.set_in(tree, "/".join([*prefix, module, _BN_LEAF[leaf]]),
+                  ti.to_np(tensor))
+    elif leaf == "weight":
+        ti.set_in(tree, "/".join([*prefix, module, "kernel"]),
+                  ti.conv2d_kernel(tensor))
+    else:
+        ti.set_in(tree, "/".join([*prefix, module, leaf]), ti.to_np(tensor))

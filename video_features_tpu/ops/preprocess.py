@@ -1,0 +1,108 @@
+"""Host-side frame preprocessing (resize / crop), numpy in, numpy out.
+
+The reference preprocesses with torchvision/PIL on CPU per frame
+(reference models/resnet/extract_resnet.py:27-33, models/transforms.py). The
+parity-critical part is interpolation: PIL resizes are *antialiased*, while
+naive bilinear (torch F.interpolate / jax.image without antialias) is not.
+We therefore keep resizes on the host using PIL exactly where the reference
+does, and do the arithmetic-only steps (scale, normalize) inside the jitted
+device function where XLA fuses them into the first conv.
+
+Implements equivalents of:
+  - torchvision Resize(size) smaller-edge semantics + CenterCrop
+    (reference models/resnet/extract_resnet.py:27-33)
+  - `resize`/`ResizeImproved` smaller/larger-edge switch
+    (reference models/transforms.py:191-242)
+  - tensor-video resize via non-antialiased bilinear for the I3D path
+    (reference models/transforms.py:76-96 uses F.interpolate)
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], dtype=np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], dtype=np.float32)
+
+_PIL_MODES = {
+    "bilinear": Image.BILINEAR,
+    "bicubic": Image.BICUBIC,
+    "nearest": Image.NEAREST,
+}
+
+
+def resize_edge_size(w: int, h: int, size: int,
+                     to_smaller_edge: bool = True) -> Tuple[int, int]:
+    """(out_w, out_h) matching PIL aspect-preserving resize.
+
+    Same rounding as reference models/transforms.py:218-229: the non-matched
+    edge is ``int(size * long/short)`` (truncation, not round).
+    """
+    if (w <= h and w == size) or (h <= w and h == size):
+        return w, h
+    if (w < h) == to_smaller_edge:
+        return size, int(size * h / w)
+    return int(size * w / h), size
+
+
+def pil_resize(img: np.ndarray, size: Union[int, Tuple[int, int]],
+               to_smaller_edge: bool = True,
+               interpolation: str = "bilinear") -> np.ndarray:
+    """Antialiased PIL resize of an HWC uint8 (or float-convertible) image.
+
+    ``size`` int: aspect-preserving to the smaller (or larger) edge, as in
+    reference models/transforms.py:191-231. ``size`` (h, w): exact.
+    """
+    pil = Image.fromarray(img)
+    mode = _PIL_MODES[interpolation]
+    if isinstance(size, int):
+        w, h = pil.size
+        ow, oh = resize_edge_size(w, h, size, to_smaller_edge)
+        if (ow, oh) == (w, h):
+            return np.asarray(pil)
+        return np.asarray(pil.resize((ow, oh), mode))
+    return np.asarray(pil.resize((size[1], size[0]), mode))
+
+
+def center_crop(img: np.ndarray, crop: Union[int, Tuple[int, int]]) -> np.ndarray:
+    """Center crop of an HWC image.
+
+    Uses torchvision's rounding, ``round((H - th) / 2)`` with banker's
+    rounding via int(round(.)), matching transforms.CenterCrop used at
+    reference extract_resnet.py:30.
+    """
+    th, tw = (crop, crop) if isinstance(crop, int) else crop
+    h, w = img.shape[:2]
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return img[i:i + th, j:j + tw]
+
+
+def tensor_center_crop(img: np.ndarray, crop_size: int) -> np.ndarray:
+    """Floor-division center crop (reference models/transforms.py:132-143).
+
+    Used by the I3D path; differs from :func:`center_crop` by using ``//``
+    instead of round, which shifts the window by one pixel on odd differences.
+    """
+    h, w = img.shape[:2]
+    i = (h - crop_size) // 2
+    j = (w - crop_size) // 2
+    return img[i:i + crop_size, j:j + crop_size]
+
+
+def bilinear_resize_no_antialias(img: np.ndarray,
+                                 out_hw: Tuple[int, int]) -> np.ndarray:
+    """Non-antialiased bilinear resize (align_corners=False).
+
+    Matches torch ``F.interpolate(mode='bilinear', align_corners=False)`` as
+    used for video tensors in reference models/transforms.py:76-96. cv2's
+    INTER_LINEAR implements the same half-pixel sampling without antialias.
+    """
+    import cv2
+    h, w = out_hw
+    return cv2.resize(img.astype(np.float32), (w, h),
+                      interpolation=cv2.INTER_LINEAR)

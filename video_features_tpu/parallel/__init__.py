@@ -1,0 +1,1 @@
+from .mesh import DataParallelApply, get_mesh, local_shard_of_list

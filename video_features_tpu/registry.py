@@ -1,0 +1,36 @@
+"""feature_type -> extractor class dispatch (lazy imports).
+
+Equivalent of the reference's if/elif ladder in main.py:21-38. Lazy importing
+keeps startup fast and lets families with heavy optional deps fail only when
+actually requested.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Type
+
+
+_DISPATCH = {
+    "resnet": ("resnet", "ExtractResNet"),
+    "r21d": ("r21d", "ExtractR21D"),
+    "s3d": ("s3d", "ExtractS3D"),
+    "i3d": ("i3d", "ExtractI3D"),
+    "clip": ("clip", "ExtractCLIP"),
+    "vggish": ("vggish", "ExtractVGGish"),
+    "raft": ("raft", "ExtractRAFT"),
+    "pwc": ("pwc", "ExtractPWC"),
+}
+
+
+def get_extractor_cls(feature_type: str) -> Type:
+    if feature_type not in _DISPATCH:
+        raise NotImplementedError(f"Unknown feature_type: {feature_type}")
+    module_name, cls_name = _DISPATCH[feature_type]
+    import importlib
+    try:
+        module = importlib.import_module(f".extractors.{module_name}",
+                                         package=__package__)
+    except ModuleNotFoundError as e:
+        raise NotImplementedError(
+            f"feature_type={feature_type!r} is registered but its extractor "
+            f"is not implemented yet ({e.name} missing)") from e
+    return getattr(module, cls_name)

@@ -1,0 +1,3 @@
+from .lists import form_list_from_user_input, form_slices
+from .sinks import (action_on_extraction, is_already_exist, load_numpy,
+                    load_pickle, make_path, write_numpy, write_pickle)

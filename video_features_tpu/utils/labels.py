@@ -1,0 +1,52 @@
+"""show_pred support: top-5 class printout against label maps.
+
+Equivalent of reference utils/utils.py:20-51 (`show_predictions_on_dataset`),
+numpy/JAX instead of torch. Label maps (Kinetics-400, ImageNet-1k class name
+lists) ship as package data.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Sequence, Union
+
+import numpy as np
+
+_DATA_DIR = Path(__file__).resolve().parent
+
+KINETICS_CLASS_PATH = _DATA_DIR / "K400_label_map.txt"
+IMAGENET_CLASS_PATH = _DATA_DIR / "IN_label_map.txt"
+
+
+def load_label_map(dataset: Union[str, Sequence[str]]) -> List[str]:
+    if dataset == "kinetics":
+        path = KINETICS_CLASS_PATH
+    elif dataset == "imagenet":
+        path = IMAGENET_CLASS_PATH
+    elif isinstance(dataset, (list, tuple)):
+        return list(dataset)
+    else:
+        raise NotImplementedError(f"dataset: {dataset}")
+    with open(path) as f:
+        return [x.strip() for x in f]
+
+
+def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def show_predictions_on_dataset(logits: np.ndarray,
+                                dataset: Union[str, Sequence[str]],
+                                k: int = 5) -> None:
+    """Print per-row top-k ``logit | prob | label`` tables
+    (same format as reference utils/utils.py:36-51)."""
+    classes = load_label_map(dataset)
+    logits = np.asarray(logits, dtype=np.float32)
+    probs = softmax(logits)
+    top_idx = np.argsort(-probs, axis=-1)[:, :k]
+    for b in range(logits.shape[0]):
+        print('  Logits | Prob. | Label ')
+        for idx in top_idx[b]:
+            print(f'{logits[b, idx]:8.3f} | {probs[b, idx]:.3f} | {classes[idx]}')
+        print()

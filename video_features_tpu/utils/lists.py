@@ -1,0 +1,55 @@
+"""Work-list building and clip-window slicing (host-side, pure Python).
+
+Covers the reference's `form_list_from_user_input` (utils/utils.py:128-167)
+and `form_slices` (utils/utils.py:59-68).
+"""
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple, Union
+
+
+def form_slices(size: int, stack_size: int, step_size: int) -> List[Tuple[int, int]]:
+    """Windows [i*step, i*step+stack) fully inside [0, size).
+
+    Matches reference utils/utils.py:59-68: the trailing partial stack is
+    dropped — that drop is observable in feature counts and is part of the
+    output contract.
+    """
+    full_stack_num = (size - stack_size) // step_size + 1
+    return [(i * step_size, i * step_size + stack_size)
+            for i in range(max(full_stack_num, 0))]
+
+
+def form_list_from_user_input(
+        video_paths: Union[str, Sequence[str], None] = None,
+        file_with_video_paths: Optional[str] = None,
+        to_shuffle: bool = True,
+) -> List[str]:
+    """Normalize user video specification into a list of paths.
+
+    Same contract as reference utils/utils.py:128-167: either an inline
+    str/list or a text file (one path per line, blank lines skipped); missing
+    paths produce a warning, not an error; optional shuffle decorrelates
+    independently-launched workers picking the same video first.
+    """
+    if file_with_video_paths is None:
+        if video_paths is None:
+            path_list: List[str] = []
+        elif isinstance(video_paths, str):
+            path_list = [video_paths]
+        else:
+            path_list = [str(p) for p in video_paths]
+    else:
+        with open(file_with_video_paths) as rfile:
+            path_list = [line.strip("\n") for line in rfile.readlines()]
+            path_list = [p for p in path_list if len(p) > 0]
+
+    for path in path_list:
+        if not Path(path).exists():
+            print(f"The path does not exist: {path}")
+
+    if to_shuffle:
+        random.shuffle(path_list)
+    return path_list

@@ -1,0 +1,134 @@
+"""Output sinks and the idempotent resume contract.
+
+Implements the reference's output behavior (models/_base/base_extractor.py:55-127
+and utils/utils.py:53-57,241-251):
+
+  - file name contract: ``{video_stem}_{key}{ext}`` under the (already
+    namespaced) output dir
+  - sinks: 'print' (max/mean/min summary), 'save_numpy' (.npy),
+    'save_pickle' (.pkl)
+  - `is_already_exist`: every expected key file must exist AND load without
+    error — loading doubles as corruption detection, which is what makes
+    independently-launched (or preempted) workers resumable.
+
+This idempotent-file contract is the framework's checkpoint format for
+preemptible TPU workers, exactly as it is the reference's de-facto resume
+mechanism.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import traceback
+from pathlib import Path
+from typing import Dict, Sequence
+
+import numpy as np
+
+EXTS = {"save_numpy": ".npy", "save_pickle": ".pkl"}
+
+
+def make_path(output_root: str, video_path: str, output_key: str, ext: str) -> str:
+    """``{output_root}/{stem}_{key}{ext}`` (reference utils/utils.py:53-57)."""
+    fname = f"{Path(video_path).stem}_{output_key}{ext}"
+    return os.path.join(str(output_root), fname)
+
+
+def load_numpy(fpath):
+    return np.load(fpath)
+
+
+def write_numpy(fpath, value):
+    return np.save(fpath, value)
+
+
+def load_pickle(fpath):
+    with open(fpath, "rb") as f:
+        return pickle.load(f)
+
+
+def write_pickle(fpath, value):
+    with open(fpath, "wb") as f:
+        pickle.dump(value, f)
+
+
+def is_already_exist(on_extraction: str, output_path: str, video_path: str,
+                     output_feat_keys: Sequence[str]) -> bool:
+    """True iff every key file exists and loads cleanly.
+
+    Mirrors reference base_extractor.py:95-127: for the 'print' sink nothing is
+    persisted, so extraction always re-runs; for file sinks a file that exists
+    but fails to load (partial write from a preempted worker) counts as absent.
+    """
+    if on_extraction == "print":
+        return False
+    if on_extraction not in EXTS:
+        raise NotImplementedError(f"on_extraction: {on_extraction}")
+    ext = EXTS[on_extraction]
+    loader = load_numpy if on_extraction == "save_numpy" else load_pickle
+
+    how_many_files_should_exist = len(output_feat_keys)
+    existing = 0
+    for key in output_feat_keys:
+        fpath = make_path(output_path, video_path, key, ext)
+        if os.path.exists(fpath):
+            try:
+                loader(fpath)
+                existing += 1
+            except Exception:
+                print(f"Failed to load: {fpath}. Will extract again.")
+    if existing == how_many_files_should_exist:
+        print(f'Features for "{video_path}" already exist in "{output_path}" — skipping. '
+              "Use a different `output_path` to extract again.")
+        return True
+    return False
+
+
+def action_on_extraction(feats_dict: Dict[str, np.ndarray],
+                         video_path: str,
+                         output_path: str,
+                         on_extraction: str) -> None:
+    """Dispatch extracted features to the configured sink.
+
+    Mirrors reference base_extractor.py:55-93 including the re-check before
+    overwrite (another worker may have finished this video while we computed)
+    and the empty-value warning.
+    """
+    if on_extraction == "print":
+        print(f"\nFeatures for: {video_path}")
+        for k, v in feats_dict.items():
+            print(k)
+            print(np.asarray(v))
+            arr = np.asarray(v)
+            if arr.dtype != object and arr.size > 0:
+                print(f"max: {arr.max():.8f}; mean: {arr.mean():.8f}; min: {arr.min():.8f}")
+            print()
+        return
+    if on_extraction not in EXTS:
+        raise NotImplementedError(f"on_extraction: {on_extraction}")
+
+    os.makedirs(output_path, exist_ok=True)
+    writer = write_numpy if on_extraction == "save_numpy" else write_pickle
+    for key, value in feats_dict.items():
+        fpath = make_path(output_path, video_path, key, EXTS[on_extraction])
+        arr = np.asarray(value)
+        if arr.size == 0:
+            print("Warning: the value is empty for", key, "@", video_path)
+        writer(fpath, value)
+
+
+def safe_extract(extract_fn, video_path: str) -> bool:
+    """Run one video; any failure prints a traceback and is non-fatal.
+
+    The per-video error isolation of reference base_extractor.py:40-53
+    (KeyboardInterrupt re-raised). Returns True on success.
+    """
+    try:
+        extract_fn(video_path)
+        return True
+    except KeyboardInterrupt:
+        raise
+    except Exception:
+        print(f"An error occurred extracting features for: {video_path}")
+        traceback.print_exc()
+        return False

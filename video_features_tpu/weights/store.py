@@ -1,0 +1,110 @@
+"""Weight resolution: find, convert and cache parameters for a model key.
+
+The reference gets weights from four places (SURVEY §2.5): local ``.pt/.pth``
+files in the repo, torchvision/torch.hub downloads, the OpenAI CDN (CLIP) and
+GitHub releases (VGGish). This environment has no network egress, so the
+story is:
+
+  1. an explicit ``weights_path`` in the config — a torch checkpoint (``.pt``,
+     ``.pth``) converted on the fly, or an already-converted ``.msgpack``;
+  2. the ``VFT_WEIGHTS_DIR`` directory (default
+     ``~/.cache/video_features_tpu``): ``{model_key}.msgpack`` converted
+     previously, or ``{model_key}.pt[h]`` torch blobs dropped there;
+  3. the torch hub cache (``$TORCH_HOME/hub/checkpoints``) for known
+     torchvision/hub filenames;
+  4. random initialization — only if ``allow_random_weights`` is set (tests,
+     dry runs, benchmarks that only measure throughput).
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+
+# known torch-hub / CDN filenames per model key, for cache probing
+HUB_FILENAMES: Dict[str, tuple] = {
+    "resnet18": ("resnet18-f37072fd.pth", "resnet18-5c106cde.pth"),
+    "resnet34": ("resnet34-b627a593.pth", "resnet34-333f7ec4.pth"),
+    "resnet50": ("resnet50-0676ba61.pth", "resnet50-19c8e357.pth"),
+    "resnet101": ("resnet101-63fe2227.pth", "resnet101-5d3b4d8f.pth"),
+    "resnet152": ("resnet152-394f9c45.pth", "resnet152-b121ed2d.pth"),
+    "r2plus1d_18_16_kinetics": ("r2plus1d_18-91a641e6.pth",),
+    "r2plus1d_34_32_ig65m_ft_kinetics": ("r2plus1d_34_clip32_ig65m_from_scratch-449a7af9.pth",),
+    "r2plus1d_34_8_ig65m_ft_kinetics": ("r2plus1d_34_clip8_ig65m_from_scratch-9bae36ae.pth",),
+}
+
+
+def weights_dir() -> Path:
+    return Path(os.environ.get(
+        "VFT_WEIGHTS_DIR", os.path.expanduser("~/.cache/video_features_tpu")))
+
+
+def find_checkpoint(model_key: str,
+                    explicit_path: Optional[str] = None) -> Optional[Path]:
+    """Locate a weight file for ``model_key`` (msgpack preferred, else torch)."""
+    if explicit_path:
+        p = Path(explicit_path)
+        if not p.exists():
+            raise FileNotFoundError(f"weights_path does not exist: {p}")
+        return p
+    wd = weights_dir()
+    for ext in (".msgpack", ".pt", ".pth"):
+        p = wd / f"{model_key}{ext}"
+        if p.exists():
+            return p
+    torch_home = Path(os.environ.get("TORCH_HOME",
+                                     os.path.expanduser("~/.cache/torch")))
+    for fname in HUB_FILENAMES.get(model_key, ()):
+        p = torch_home / "hub" / "checkpoints" / fname
+        if p.exists():
+            return p
+    return None
+
+
+def save_msgpack(params: Any, path: Path) -> None:
+    from flax import serialization
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(params))
+
+
+def load_msgpack(template: Any, path: Path) -> Any:
+    from flax import serialization
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def resolve_params(model_key: str,
+                   init_fn: Callable[[], Any],
+                   convert_fn: Callable[[Dict[str, Any]], Any],
+                   weights_path: Optional[str] = None,
+                   allow_random: bool = False,
+                   cache_converted: bool = True) -> Any:
+    """Return a parameter tree for ``model_key``.
+
+    ``init_fn``: builds a randomly-initialized tree (also the msgpack
+    template). ``convert_fn``: maps a torch state_dict onto that tree.
+    """
+    ckpt = find_checkpoint(model_key, weights_path)
+    if ckpt is None:
+        if allow_random:
+            print(f"WARNING: no weights found for {model_key!r}; using RANDOM "
+                  "init (allow_random_weights=true). Features will be "
+                  "meaningless — for tests/benchmarks only.")
+            return init_fn()
+        raise FileNotFoundError(
+            f"No weights for {model_key!r}. Provide `weights_path=...`, drop "
+            f"a checkpoint into {weights_dir()}, or set "
+            "`allow_random_weights=true` for throughput-only runs. Known "
+            f"source filenames: {HUB_FILENAMES.get(model_key, '(model-specific)')}")
+    if ckpt.suffix == ".msgpack":
+        return load_msgpack(init_fn(), ckpt)
+    from .torch_import import load_torch_state_dict
+    params = convert_fn(load_torch_state_dict(str(ckpt)))
+    if cache_converted:
+        out = weights_dir() / f"{model_key}.msgpack"
+        try:
+            save_msgpack(params, out)
+        except OSError:
+            pass
+    return params

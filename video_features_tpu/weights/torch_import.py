@@ -1,0 +1,84 @@
+"""torch checkpoint -> Flax parameter transplant utilities.
+
+The reference loads `.pt/.pth` torch state dicts (or fetches them from
+torchvision / torch.hub / the OpenAI CDN — reference
+models/_base/base_flow_extractor.py:118-137, models/r21d/extract_r21d.py:105-113,
+models/clip/clip_src/clip.py:32-74). This module holds the generic layout
+rules for converting those tensors into our NHWC/HWIO JAX trees; each model
+file contributes its own key-mapping function built on these helpers.
+
+Layout rules:
+  - conv2d   OIHW  -> HWIO
+  - conv3d   OIDHW -> DHWIO
+  - linear   (out, in) -> (in, out)
+  - batchnorm weight/bias/running_mean/running_var -> scale/bias/mean/var
+
+torch is imported lazily: it is only needed when converting checkpoints (or in
+parity tests), never on the TPU serving path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def to_np(t) -> np.ndarray:
+    """torch tensor -> float32/original-dtype numpy array (detached, CPU)."""
+    arr = t.detach().cpu().numpy()
+    return arr
+
+
+def conv2d_kernel(t) -> np.ndarray:
+    """OIHW -> HWIO."""
+    return np.transpose(to_np(t), (2, 3, 1, 0))
+
+
+def conv3d_kernel(t) -> np.ndarray:
+    """OIDHW -> DHWIO."""
+    return np.transpose(to_np(t), (2, 3, 4, 1, 0))
+
+
+def linear_kernel(t) -> np.ndarray:
+    """(out, in) -> (in, out)."""
+    return np.transpose(to_np(t), (1, 0))
+
+
+def bn_params(state_dict: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    """Map a torch BatchNorm{1,2,3}d at ``prefix`` to our inference-BN tree."""
+    return {
+        "scale": to_np(state_dict[f"{prefix}.weight"]),
+        "bias": to_np(state_dict[f"{prefix}.bias"]),
+        "mean": to_np(state_dict[f"{prefix}.running_mean"]),
+        "var": to_np(state_dict[f"{prefix}.running_var"]),
+    }
+
+
+def set_in(tree: Dict[str, Any], path: str, value: np.ndarray) -> None:
+    """Insert ``value`` at slash-separated ``path`` in a nested dict."""
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def strip_module_prefix(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Undo torch DataParallel's 'module.' prefix (reference utils/utils.py:232-238)."""
+    out = {}
+    for k, v in state_dict.items():
+        out[k[len("module."):] if k.startswith("module.") else k] = v
+    return out
+
+
+def load_torch_state_dict(path: str) -> Dict[str, Any]:
+    """Load a torch checkpoint file to CPU and unwrap common containers."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(obj, dict):
+        for key in ("state_dict", "model_state_dict", "model"):
+            if key in obj and isinstance(obj[key], dict):
+                obj = obj[key]
+                break
+    return strip_module_prefix(obj)

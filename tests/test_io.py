@@ -86,6 +86,10 @@ def test_fps_filter_map_properties():
     # identity
     m3 = fps_filter_map(50, 25.0, 25.0)
     assert np.array_equal(m3, np.arange(50))
+    # exact 2x downsample must be temporally uniform (half-away-from-zero
+    # rounding; banker's rounding would give jittery [1,2,5,6,9,...])
+    m4 = fps_filter_map(20, 30.0, 15.0)
+    assert np.array_equal(m4[:-1], 2 * np.arange(len(m4) - 1))
 
 
 def test_read_video_frames_shape(sample_video):

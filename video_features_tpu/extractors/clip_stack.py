@@ -1,0 +1,61 @@
+"""Clip-stack extraction pipeline (R(2+1)D, S3D).
+
+Re-design of the reference's whole-video + per-slice serial loop
+(reference models/r21d/extract_r21d.py:60-94, models/s3d/extract_s3d.py:40-75):
+
+  host:   stream-decode -> per-frame resize/crop -> (T, H, W, 3) float32
+          -> `form_slices` windows (trailing partial stack dropped, same
+          observable contract as reference utils/utils.py:59-68)
+  device: (clip_batch, stack, H, W, 3) fixed-shape jitted forward, the
+          clip-batch axis sharded over the mesh's data axis.
+
+Where the reference runs batch=1 slices sequentially (extract_r21d.py:84-88),
+clips here are batched into one jitted call — each 3D-conv matmul gets a
+bigger batch dim for the MXU and ragged tails are padded, so exactly one
+executable per (stack_size, H, W) is compiled.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..parallel.mesh import DataParallelApply
+from ..utils.io import VideoSource
+from ..utils.lists import form_slices
+from .base import BaseExtractor
+
+
+class ClipStackExtractor(BaseExtractor):
+    """Families plug in ``host_transform``, ``runner``, defaults, show_pred."""
+
+    def __init__(self, args: Config, default_stack: int, default_step: int) -> None:
+        super().__init__(args)
+        self.model_name = args.get("model_name")
+        self.stack_size = args.get("stack_size") or default_stack
+        self.step_size = args.get("step_size") or default_step
+        self.extraction_fps = args.get("extraction_fps")
+        self.clip_batch_size = int(args.get("clip_batch_size") or 8)
+        self.output_feat_keys = [self.feature_type]
+        self.host_transform: Optional[Callable] = None
+        self.runner: Optional[DataParallelApply] = None
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
+                          transform=self.host_transform)
+        frames = [f for f, _, _ in src.frames()]
+        slices = form_slices(len(frames), self.stack_size, self.step_size)
+        vid_feats: List[np.ndarray] = []
+        if slices:
+            all_frames = np.stack(frames)  # (T, H, W, 3)
+            stacks = np.stack([all_frames[s:e] for s, e in slices])
+            for i in range(0, len(stacks), self.clip_batch_size):
+                group = stacks[i:i + self.clip_batch_size]
+                feats = self.runner(group)  # pads ragged tails to fixed_batch
+                self.maybe_show_pred(feats, slices[i:i + group.shape[0]])
+                vid_feats.extend(list(feats))
+        return {self.feature_type: np.array(vid_feats)}
+
+    def maybe_show_pred(self, feats: np.ndarray, slices) -> None:
+        pass

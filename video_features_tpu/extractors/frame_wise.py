@@ -56,12 +56,8 @@ class FrameWiseExtractor(BaseExtractor):
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         for batch, times, _ in video:
-            arr = np.stack(batch)
-            n_valid = arr.shape[0]
-            if n_valid < self.batch_size:  # pad ragged tail to the fixed shape
-                pad = [(0, self.batch_size - n_valid)] + [(0, 0)] * (arr.ndim - 1)
-                arr = np.pad(arr, pad)
-            feats = self.runner(arr, n_valid=n_valid)
+            arr = np.stack(batch)  # runner pads ragged tails to fixed_batch
+            feats = self.runner(arr)
             self.maybe_show_pred(feats)
             vid_feats.extend(list(feats))
             timestamps_ms.extend(times)

@@ -1,0 +1,79 @@
+"""R(2+1)D clip-stack extractor.
+
+Parity target: reference models/r21d/extract_r21d.py — three model flavors
+with per-flavor default stack/step (16/16, 32/32, 8/8), transform stack
+[0,1]-float -> bilinear Resize(128,171) (non-antialiased) -> K400 Normalize ->
+CenterCrop(112) (extract_r21d.py:50-55), fc swapped for Identity with the
+Kinetics head kept for show_pred. Output key: ['r21d'] only
+(extract_r21d.py:57).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models import r21d as r21d_model
+from ..ops import preprocess as pp
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..utils.labels import show_predictions_on_dataset
+from ..weights import store
+from .clip_stack import ClipStackExtractor
+
+
+def _device_forward(model: r21d_model.R2Plus1D, dtype, params, batch):
+    """(B, T, 112, 112, 3) float [0,1] -> (B, 512); K400-normalize fused."""
+    x = (batch - jnp.asarray(r21d_model.R21D_MEAN, batch.dtype)) / \
+        jnp.asarray(r21d_model.R21D_STD, batch.dtype)
+    x = x.astype(dtype)
+    return model.apply({"params": params}, x).astype(jnp.float32)
+
+
+class ExtractR21D(ClipStackExtractor):
+
+    def __init__(self, args: Config) -> None:
+        if args.model_name not in r21d_model.VARIANTS:
+            raise NotImplementedError(f"Model {args.model_name} not found.")
+        _, default_stack = r21d_model.VARIANTS[args.model_name]
+        super().__init__(args, default_stack=default_stack,
+                         default_step=default_stack)
+
+        self.model = r21d_model.R2Plus1D(self.model_name)
+        self.head = r21d_model.Classifier()
+
+        def init_fn():
+            import jax
+            v = self.model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 4, 112, 112, 3)))
+            h = self.head.init(jax.random.PRNGKey(1),
+                               jnp.zeros((1, r21d_model.FEATURE_DIM)))
+            return {"backbone": v["params"], "head": h["params"]}
+
+        params = store.resolve_params(
+            self.model_name, init_fn, r21d_model.params_from_torch,
+            weights_path=args.get("weights_path"),
+            allow_random=bool(args.get("allow_random_weights", False)))
+        self.head_params = params["head"]
+
+        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_device_forward, self.model, dtype),
+            params["backbone"], mesh=mesh, fixed_batch=self.clip_batch_size)
+
+        def transform(rgb: np.ndarray) -> np.ndarray:
+            x = rgb.astype(np.float32) / 255.0
+            x = pp.bilinear_resize_no_antialias(x, (128, 171))
+            return pp.center_crop(x, 112)
+
+        self.host_transform = transform
+
+    def maybe_show_pred(self, feats: np.ndarray, slices) -> None:
+        if self.show_pred:
+            logits = np.asarray(self.head.apply({"params": self.head_params},
+                                                jnp.asarray(feats)))
+            for row, (s, e) in zip(logits, slices):
+                print(f"At frames ({s}, {e})")
+                show_predictions_on_dataset(row[None], "kinetics")

@@ -56,7 +56,7 @@ class ExtractResNet(FrameWiseExtractor):
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.runner = DataParallelApply(
             partial(_device_forward, self.model, dtype),
-            params["backbone"], mesh=mesh)
+            params["backbone"], mesh=mesh, fixed_batch=self.batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, 256, interpolation="bilinear")

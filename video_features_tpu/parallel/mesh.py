@@ -82,9 +82,11 @@ class DataParallelApply:
                  apply_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                  params: Any,
                  mesh: Optional[Mesh] = None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data",
+                 fixed_batch: Optional[int] = None):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.data_axis = data_axis
+        self.fixed_batch = fixed_batch
         batch_sharding = NamedSharding(self.mesh, P(data_axis))
         replicated = NamedSharding(self.mesh, P())
         self.params = jax.device_put(params, replicated)
@@ -105,8 +107,14 @@ class DataParallelApply:
 
     def __call__(self, batch_np: np.ndarray, n_valid: Optional[int] = None
                  ) -> np.ndarray:
+        """Run a (possibly ragged) batch; returns only the valid rows.
+
+        Pads up to ``fixed_batch`` (if set — one executable per video) and
+        then to a mesh-divisible size, drops padded rows after execution.
+        """
         n = batch_np.shape[0] if n_valid is None else n_valid
-        full = self.padded_batch_size(batch_np.shape[0])
+        target = max(batch_np.shape[0], self.fixed_batch or 0)
+        full = self.padded_batch_size(target)
         if full != batch_np.shape[0]:
             pad_width = [(0, full - batch_np.shape[0])] + \
                         [(0, 0)] * (batch_np.ndim - 1)

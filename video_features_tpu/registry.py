@@ -26,11 +26,13 @@ def get_extractor_cls(feature_type: str) -> Type:
         raise NotImplementedError(f"Unknown feature_type: {feature_type}")
     module_name, cls_name = _DISPATCH[feature_type]
     import importlib
+    full_module = f"{__package__}.extractors.{module_name}"
     try:
-        module = importlib.import_module(f".extractors.{module_name}",
-                                         package=__package__)
+        module = importlib.import_module(full_module)
     except ModuleNotFoundError as e:
+        if e.name != full_module:
+            raise  # a real missing dependency, not an unimplemented family
         raise NotImplementedError(
             f"feature_type={feature_type!r} is registered but its extractor "
-            f"is not implemented yet ({e.name} missing)") from e
+            "is not implemented yet") from e
     return getattr(module, cls_name)

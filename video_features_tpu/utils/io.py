@@ -80,7 +80,10 @@ def fps_filter_map(num_frames: int, src_fps: float, dst_fps: float) -> np.ndarra
     if num_frames <= 0:
         return np.zeros((0,), dtype=np.int64)
     i = np.arange(num_frames, dtype=np.float64)
-    slots = np.round(i * (dst_fps / src_fps)).astype(np.int64)
+    # half-away-from-zero rounding (ffmpeg AV_ROUND_NEAR_INF), NOT np.round's
+    # banker's rounding: at an exact 2x downsample the two differ and banker's
+    # rounding would select temporally non-uniform frames
+    slots = np.floor(i * (dst_fps / src_fps) + 0.5).astype(np.int64)
     n_out = int(slots[-1]) + 1
     mapping = np.zeros((n_out,), dtype=np.int64)
     # latest input frame per slot wins; forward-fill gaps
@@ -190,6 +193,15 @@ class VideoSource:
                     while src_idx < want:
                         nxt = stream.read()
                         if nxt is None:
+                            if out_idx < len(self.index_map) - 1:
+                                # container metadata overstated the frame
+                                # count; the resampled output is shorter than
+                                # planned (decoded frames are still correct)
+                                print(f"Warning: {self.path} ended after "
+                                      f"{src_idx + 1} frames (metadata said "
+                                      f"{self.src_num_frames}); emitted "
+                                      f"{out_idx}/{len(self.index_map)} "
+                                      "resampled frames.")
                             return
                         current = nxt
                         src_idx += 1

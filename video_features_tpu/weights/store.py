@@ -101,6 +101,10 @@ def resolve_params(model_key: str,
         return load_msgpack(init_fn(), ckpt)
     from .torch_import import load_torch_state_dict
     params = convert_fn(load_torch_state_dict(str(ckpt)))
+    if weights_path:
+        # an explicit (possibly fine-tuned) checkpoint must not poison the
+        # generic {model_key}.msgpack cache used by weights_path-less runs
+        cache_converted = False
     if cache_converted:
         out = weights_dir() / f"{model_key}.msgpack"
         try:

@@ -1,0 +1,94 @@
+"""Benchmark: R(2+1)D-18 clip throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "clips/sec/chip", "vs_baseline": N}
+
+The reference publishes no throughput numbers (BASELINE.md), so the baseline
+here is measured: the same R(2+1)D-18 architecture run in torch (the
+reference's engine) on this host's CPU, batch=1 serial slices exactly like
+reference models/r21d/extract_r21d.py:84-88. ``vs_baseline`` is
+ours/theirs on identical clip shapes (16 frames, 112x112).
+
+Our number is the steady-state jitted forward on (B,16,112,112,3) uint8
+batches (including H2D transfer), bfloat16 matmuls (the TPU production mode),
+B=16 clips per step.
+"""
+import json
+import time
+
+import numpy as np
+
+CLIP = (16, 112, 112, 3)  # stack, H, W, C
+BATCH = 16
+WARMUP = 3
+ITERS = 10
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+    from video_features_tpu.models.r21d import R2Plus1D, R21D_MEAN, R21D_STD
+
+    model = R2Plus1D("r2plus1d_18_16_kinetics")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4, 112, 112, 3)))["params"]
+
+    @jax.jit
+    def forward(p, batch_u8):
+        x = batch_u8.astype(jnp.float32) / 255.0
+        x = (x - jnp.asarray(R21D_MEAN)) / jnp.asarray(R21D_STD)
+        return model.apply({"params": p}, x.astype(jnp.bfloat16))
+
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, 255, size=(BATCH, *CLIP), dtype=np.uint8)
+               for _ in range(2)]
+    forward(params, batches[0]).block_until_ready()  # compile
+    for _ in range(WARMUP):
+        forward(params, batches[1]).block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = forward(params, batches[i % 2])
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return BATCH * ITERS / dt
+
+
+def bench_torch_reference() -> float:
+    """Reference-style serial batch=1 torch forward on this host's CPU."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    import torch
+    from torch_oracles import TorchR2Plus1D
+
+    model = TorchR2Plus1D(layers=(2, 2, 2, 2)).eval()
+    x = torch.randn(1, 3, *CLIP[:3])
+    with torch.no_grad():
+        model(x)  # warmup
+        n = 3
+        t0 = time.perf_counter()
+        for _ in range(n):
+            model(x)
+        dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main() -> None:
+    ours = bench_ours()
+    try:
+        theirs = bench_torch_reference()
+        ratio = ours / theirs
+    except Exception:
+        theirs, ratio = None, None
+    import jax
+    platform = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": f"r2plus1d_18 16f@112px clip throughput ({platform}, bf16)",
+        "value": round(ours, 2),
+        "unit": "clips/sec/chip",
+        "vs_baseline": round(ratio, 2) if ratio is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

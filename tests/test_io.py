@@ -105,6 +105,11 @@ def test_transform_applied(sample_video):
     batch, _, _ = next(iter(src))
     assert batch[0].shape == (10, 12, 3)
     assert batch[0].dtype == np.float32
+    # the frames() view (used by clip-stack extractors) must apply the
+    # transform too — regression for the silently-skipped-resize bug
+    frame, _, _ = next(iter(src.frames()))
+    assert frame.shape == (10, 12, 3)
+    assert frame.dtype == np.float32
 
 
 def test_form_slices_drops_partial_tail():

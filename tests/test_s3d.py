@@ -1,0 +1,101 @@
+"""S3D: parity against the actual reference torch model (imported read-only
+from /root/reference as the numerical oracle), resize semantics, E2E."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.models import s3d as s3d_model  # noqa: E402
+from video_features_tpu.ops import preprocess as pp  # noqa: E402
+from tests.torch_oracles import randomize_bn_stats  # noqa: E402
+
+REF_S3D = "/root/reference/models/s3d/s3d_src/s3d.py"
+
+
+def _load_reference_s3d():
+    if not os.path.exists(REF_S3D):
+        pytest.skip("reference S3D source not available")
+    spec = importlib.util.spec_from_file_location("ref_s3d", REF_S3D)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flax_matches_reference_torch():
+    ref = _load_reference_s3d()
+    torch.manual_seed(0)
+    oracle = ref.S3D(num_class=400).eval()
+    randomize_bn_stats(oracle)
+    params = s3d_model.params_from_torch(oracle.state_dict())
+    model = s3d_model.S3D(num_classes=400)
+
+    # stem/pools stride time by 2 three times: T=24 -> 3 at the head (>=2
+    # needed for the size-2 temporal avg pool)
+    x = np.random.default_rng(0).uniform(
+        size=(1, 24, 96, 96, 3)).astype(np.float32)
+    xt = torch.from_numpy(x).permute(0, 4, 1, 2, 3)
+    with torch.no_grad():
+        want_feats = oracle(xt, features=True).numpy()
+        want_logits = oracle(xt, features=False).numpy()
+    got_feats = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                       features=True))
+    got_logits = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                        features=False))
+    assert got_feats.shape == want_feats.shape == (1, 1024)
+    np.testing.assert_allclose(got_feats, want_feats, atol=5e-4, rtol=5e-4)
+    assert got_logits.shape == want_logits.shape == (1, 400)
+    np.testing.assert_allclose(got_logits, want_logits, atol=5e-4, rtol=5e-4)
+
+
+def test_scale_factor_resize_matches_torch():
+    # the reference's int-size Resize uses F.interpolate(scale_factor=...)
+    # (models/transforms.py:86-96); our host resize must match it exactly
+    import torch.nn.functional as F
+    rng = np.random.default_rng(0)
+    img = rng.uniform(size=(240, 320, 3)).astype(np.float32)
+    scale = 224.0 / 240.0
+    want = F.interpolate(torch.from_numpy(img).permute(2, 0, 1)[None],
+                         scale_factor=scale, mode="bilinear",
+                         align_corners=False, recompute_scale_factor=False)
+    want = want[0].permute(1, 2, 0).numpy()
+    got = pp.bilinear_resize_by_scale(img, scale)
+    assert got.shape == want.shape
+    # torch computes interpolation weights in float32; ours are float64
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_end_to_end_extraction(sample_video, tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.s3d import ExtractS3D
+
+    cfg = load_config("s3d", {
+        "video_paths": sample_video, "device": "cpu",
+        "stack_size": 24, "step_size": 24, "extraction_fps": 6,
+        "clip_batch_size": 2,
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractS3D(cfg)
+    feats = ex._extract(sample_video)
+    # ~18.1s @6fps = ~109 frames -> 4 full 24-frame stacks
+    assert feats["s3d"].shape == (4, 1024)
+    assert ex.output_feat_keys == ["s3d"]
+
+
+def test_default_fps_forced_to_25(tmp_path, sample_video):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.s3d import ExtractS3D
+    cfg = load_config("s3d", {
+        "video_paths": sample_video, "device": "cpu", "extraction_fps": None,
+        "allow_random_weights": True,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    sanity_check(cfg)
+    ex = ExtractS3D(cfg)
+    assert ex.extraction_fps == 25  # reference extract_s3d.py:29

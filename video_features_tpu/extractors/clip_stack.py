@@ -49,13 +49,17 @@ class ClipStackExtractor(BaseExtractor):
         vid_feats: List[np.ndarray] = []
         if slices:
             all_frames = np.stack(frames)  # (T, H, W, 3)
-            stacks = np.stack([all_frames[s:e] for s, e in slices])
-            for i in range(0, len(stacks), self.clip_batch_size):
-                group = stacks[i:i + self.clip_batch_size]
+            for i in range(0, len(slices), self.clip_batch_size):
+                # materialize only this group's windows: with overlapping
+                # windows (step < stack) stacking all of them up front would
+                # multiply peak host memory by stack_size/step_size
+                window = slices[i:i + self.clip_batch_size]
+                group = np.stack([all_frames[s:e] for s, e in window])
                 feats = self.runner(group)  # pads ragged tails to fixed_batch
-                self.maybe_show_pred(feats, slices[i:i + group.shape[0]])
+                self.maybe_show_pred(feats, window, group)
                 vid_feats.extend(list(feats))
         return {self.feature_type: np.array(vid_feats)}
 
-    def maybe_show_pred(self, feats: np.ndarray, slices) -> None:
+    def maybe_show_pred(self, feats: np.ndarray, slices,
+                        group: Optional[np.ndarray] = None) -> None:
         pass

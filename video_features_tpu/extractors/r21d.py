@@ -70,7 +70,7 @@ class ExtractR21D(ClipStackExtractor):
 
         self.host_transform = transform
 
-    def maybe_show_pred(self, feats: np.ndarray, slices) -> None:
+    def maybe_show_pred(self, feats: np.ndarray, slices, group=None) -> None:
         if self.show_pred:
             logits = np.asarray(self.head.apply({"params": self.head_params},
                                                 jnp.asarray(feats)))

@@ -1,0 +1,71 @@
+"""S3D clip-stack extractor.
+
+Parity target: reference models/s3d/extract_s3d.py — defaults stack=step=64,
+extraction_fps=25 (forced even when None, extract_s3d.py:29), transform
+[0,1]-float -> scale-factor Resize(224) -> CenterCrop(224) with NO
+normalization by design (extract_s3d.py:30-35), `model(x, features=True)`
+skipping the classifier. Output key: ['s3d'].
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models import s3d as s3d_model
+from ..ops import preprocess as pp
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..utils.labels import show_predictions_on_dataset
+from ..weights import store
+from .clip_stack import ClipStackExtractor
+
+
+def _device_forward(model: s3d_model.S3D, dtype, features, params, batch):
+    x = batch.astype(dtype)
+    return model.apply({"params": params}, x,
+                       features=features).astype(jnp.float32)
+
+
+class ExtractS3D(ClipStackExtractor):
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args, default_stack=64, default_step=64)
+        if self.extraction_fps is None:
+            self.extraction_fps = 25  # reference extract_s3d.py:29
+
+        self.model = s3d_model.S3D(num_classes=400)
+        params = store.resolve_params(
+            "s3d_kinetics400", s3d_model.init_params,
+            s3d_model.params_from_torch,
+            weights_path=args.get("weights_path"),
+            allow_random=bool(args.get("allow_random_weights", False)))
+        self.params = params
+
+        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_device_forward, self.model, dtype, True),
+            params, mesh=mesh, fixed_batch=self.clip_batch_size)
+        self._logits_runner = DataParallelApply(
+            partial(_device_forward, self.model, dtype, False),
+            params, mesh=mesh, fixed_batch=self.clip_batch_size) \
+            if self.show_pred else None
+
+        def transform(rgb: np.ndarray) -> np.ndarray:
+            x = rgb.astype(np.float32) / 255.0
+            scale = 224.0 / min(x.shape[0], x.shape[1])
+            x = pp.bilinear_resize_by_scale(x, scale)
+            return pp.center_crop(x, 224)
+
+        self.host_transform = transform
+
+    def maybe_show_pred(self, feats: np.ndarray, slices, group=None) -> None:
+        # the reference runs the model a second time with features=False on
+        # the same stack (extract_s3d.py:95-99)
+        if self.show_pred and group is not None:
+            logits = self._logits_runner(group)
+            for row, (s, e) in zip(np.asarray(logits), slices):
+                print(f"At frames ({s}, {e})")
+                show_predictions_on_dataset(row[None], "kinetics")

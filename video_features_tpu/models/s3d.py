@@ -1,0 +1,183 @@
+"""S3D (separable 3D Inception, Kinetics-400) as a Flax module, NDHWC.
+
+Parity target: the reference's S3D (reference models/s3d/s3d_src/s3d.py,
+itself the kylemin/S3D network): an Inception-v1 trunk where every kxkxk conv
+is factorized into a spatial (1,k,k) conv and a temporal (k,1,1) conv, each
+followed by BatchNorm(eps=1e-3) + ReLU (SepConv3d, s3d.py:66-87); 1x1x1 convs
+are plain conv+BN+ReLU (BasicConv3d, s3d.py:52-63). Nine Mixed blocks with
+the classic GoogLeNet channel spec (s3d.py:90-348). Head (s3d.py:35-48):
+avg_pool3d over (2, H, W) stride 1, optional 1x1x1 conv classifier, then mean
+over the remaining time axis. ``features=True`` skips the classifier and
+yields the 1024-d embedding the extractor stores.
+
+Weight transplant: :func:`params_from_torch` maps the
+``S3D_kinetics400_torchified.pt`` state_dict (``base.<idx>.`` Sequential
+keys, s3d.py:9-30) onto this tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .common import BNInf, max_pool_same_torch
+from ..weights import torch_import as ti
+
+FEATURE_DIM = 1024
+
+# (branch0_1x1, (b1_reduce, b1_out), (b2_reduce, b2_out), b3_pool_proj)
+MIXED_SPECS = {
+    "m3b": (64, (96, 128), (16, 32), 32),
+    "m3c": (128, (128, 192), (32, 96), 64),
+    "m4b": (192, (96, 208), (16, 48), 64),
+    "m4c": (160, (112, 224), (24, 64), 64),
+    "m4d": (128, (128, 256), (24, 64), 64),
+    "m4e": (112, (144, 288), (32, 64), 64),
+    "m4f": (256, (160, 320), (32, 128), 128),
+    "m5b": (256, (160, 320), (32, 128), 128),
+    "m5c": (384, (192, 384), (48, 128), 128),
+}
+
+BN_EPS = 1e-3  # s3d.py:56 — NOT the torch default 1e-5
+
+
+def _conv3d(features: int, kernel: Tuple[int, int, int],
+            stride: Tuple[int, int, int], pad: Tuple[int, int, int],
+            name: str, use_bias: bool = False) -> nn.Conv:
+    return nn.Conv(features, kernel, strides=stride,
+                   padding=[(p, p) for p in pad], use_bias=use_bias, name=name)
+
+
+class BasicConv3d(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = _conv3d(self.features, (1, 1, 1), (1, 1, 1), (0, 0, 0), "conv")(x)
+        return nn.relu(BNInf(BN_EPS, name="bn")(x))
+
+
+class SepConv3d(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    pad: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        k, s, p = self.kernel, self.stride, self.pad
+        x = _conv3d(self.features, (1, k, k), (1, s, s), (0, p, p), "conv_s")(x)
+        x = nn.relu(BNInf(BN_EPS, name="bn_s")(x))
+        x = _conv3d(self.features, (k, 1, 1), (s, 1, 1), (p, 0, 0), "conv_t")(x)
+        return nn.relu(BNInf(BN_EPS, name="bn_t")(x))
+
+
+class Mixed(nn.Module):
+    spec: Tuple
+
+    @nn.compact
+    def __call__(self, x):
+        b0_out, (b1_red, b1_out), (b2_red, b2_out), b3_out = self.spec
+        b0 = BasicConv3d(b0_out, name="branch0_0")(x)
+        b1 = BasicConv3d(b1_red, name="branch1_0")(x)
+        b1 = SepConv3d(b1_out, name="branch1_1")(b1)
+        b2 = BasicConv3d(b2_red, name="branch2_0")(x)
+        b2 = SepConv3d(b2_out, name="branch2_1")(b2)
+        b3 = max_pool_same_torch(x, (3, 3, 3), (1, 1, 1),
+                                 ((1, 1), (1, 1), (1, 1)))
+        b3 = BasicConv3d(b3_out, name="branch3_1")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class S3D(nn.Module):
+    """(N, T, 224, 224, 3) float [0,1] -> (N, 1024) features (features=True)
+    or (N, 400) logits."""
+    num_classes: int = 400
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, features: bool = True) -> jnp.ndarray:
+        x = SepConv3d(64, kernel=7, stride=2, pad=3, name="stem_sep1")(x)
+        x = max_pool_same_torch(x, (1, 3, 3), (1, 2, 2),
+                                ((0, 0), (1, 1), (1, 1)))
+        x = BasicConv3d(64, name="stem_basic")(x)
+        x = SepConv3d(192, kernel=3, stride=1, pad=1, name="stem_sep2")(x)
+        x = max_pool_same_torch(x, (1, 3, 3), (1, 2, 2),
+                                ((0, 0), (1, 1), (1, 1)))
+        x = Mixed(MIXED_SPECS["m3b"], name="m3b")(x)
+        x = Mixed(MIXED_SPECS["m3c"], name="m3c")(x)
+        x = max_pool_same_torch(x, (3, 3, 3), (2, 2, 2),
+                                ((1, 1), (1, 1), (1, 1)))
+        for name in ("m4b", "m4c", "m4d", "m4e", "m4f"):
+            x = Mixed(MIXED_SPECS[name], name=name)(x)
+        x = max_pool_same_torch(x, (2, 2, 2), (2, 2, 2),
+                                ((0, 0), (0, 0), (0, 0)))
+        x = Mixed(MIXED_SPECS["m5b"], name="m5b")(x)
+        x = Mixed(MIXED_SPECS["m5c"], name="m5c")(x)
+
+        # head (s3d.py:35-48): (2,H,W) stride-1 avg pool == mean over H,W plus
+        # a size-2 sliding mean over time
+        if x.shape[1] < 2:
+            # the torch reference raises here too (avg_pool3d kernel 2 >
+            # input); without this check the empty slice below would
+            # silently produce NaN features
+            raise ValueError(
+                f"S3D needs >=2 temporal positions at the head, got "
+                f"{x.shape[1]}; use stack_size >= 16")
+        x = jnp.mean(x, axis=(2, 3))               # (N, T, 1024)
+        x = (x[:, :-1] + x[:, 1:]) * 0.5           # (N, T-1, 1024)
+        if not features:
+            x = _conv3d(self.num_classes, (1, 1, 1), (1, 1, 1), (0, 0, 0),
+                        "fc", use_bias=True)(x[:, :, None, None, :])
+            x = x[:, :, 0, 0, :]
+        return jnp.mean(x, axis=1)
+
+
+_BN_LEAF = {"weight": "scale", "bias": "bias",
+            "running_mean": "mean", "running_var": "var"}
+
+# base.<idx> Sequential position -> our module name (s3d.py:9-27)
+_BASE_IDX = {"0": "stem_sep1", "2": "stem_basic", "3": "stem_sep2",
+             "5": "m3b", "6": "m3c", "8": "m4b", "9": "m4c", "10": "m4d",
+             "11": "m4e", "12": "m4f", "14": "m5b", "15": "m5c"}
+
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Reference S3D state_dict -> Flax tree (fc folded into the same tree)."""
+    params: Dict[str, Any] = {}
+    for key, tensor in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        if parts[0] == "fc":
+            # fc.0.{weight,bias}: 1x1x1 Conv3d classifier
+            leaf = "kernel" if parts[2] == "weight" else "bias"
+            val = ti.conv3d_kernel(tensor) if leaf == "kernel" else ti.to_np(tensor)
+            ti.set_in(params, f"fc/{leaf}", val)
+            continue
+        assert parts[0] == "base", f"unexpected S3D key {key}"
+        block = _BASE_IDX[parts[1]]
+        rest = parts[2:]
+        if rest[0].startswith("branch"):
+            # branch1.1.conv_s.weight -> branch1_1/conv_s/...
+            sub = f"{rest[0]}_{rest[1]}"
+            rest = [sub] + rest[2:]
+        module, leaf = rest[-2], rest[-1]
+        prefix = "/".join([block] + rest[:-2])
+        if module.startswith("bn"):
+            ti.set_in(params, f"{prefix}/{module}/{_BN_LEAF[leaf]}",
+                      ti.to_np(tensor))
+        else:
+            ti.set_in(params, f"{prefix}/{module}/kernel",
+                      ti.conv3d_kernel(tensor))
+    return params
+
+
+def init_params(num_classes: int = 400) -> Dict[str, Any]:
+    model = S3D(num_classes)
+    # T=16 is the smallest stack that leaves >=2 temporal positions at the
+    # head (time is strided 2x at the stem and both 3D maxpools)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 64, 64, 3)),
+                   features=False)
+    return v["params"]

@@ -106,3 +106,35 @@ def bilinear_resize_no_antialias(img: np.ndarray,
     h, w = out_hw
     return cv2.resize(img.astype(np.float32), (w, h),
                       interpolation=cv2.INTER_LINEAR)
+
+
+def _bilinear_axis_weights(n_out: int, n_in: int, scale: float):
+    """Half-pixel bilinear gather indices/weights for one axis."""
+    src = (np.arange(n_out, dtype=np.float64) + 0.5) / scale - 0.5
+    src = np.clip(src, 0.0, n_in - 1)
+    lo = np.floor(src).astype(np.int64)
+    hi = np.minimum(lo + 1, n_in - 1)
+    w_hi = (src - lo).astype(np.float32)
+    return lo, hi, w_hi
+
+
+def bilinear_resize_by_scale(img: np.ndarray, scale: float) -> np.ndarray:
+    """torch ``F.interpolate(scale_factor=s, recompute_scale_factor=False)``.
+
+    The reference's int-size Resize (models/transforms.py:86-96) passes a
+    *scale factor*, and torch then maps coordinates with that exact scale —
+    NOT with out_size/in_size as cv2 does — so the two differ by a sub-pixel
+    drift that grows toward the image edge. This implements torch's mapping
+    exactly: out size floor(in*s), src = (dst+0.5)/s - 0.5, clamped, no
+    antialias.
+    """
+    h, w = img.shape[:2]
+    oh, ow = int(h * scale), int(w * scale)
+    ylo, yhi, wy = _bilinear_axis_weights(oh, h, scale)
+    xlo, xhi, wx = _bilinear_axis_weights(ow, w, scale)
+    im = img.astype(np.float32)
+    top = im[ylo][:, xlo] * (1 - wx)[None, :, None] + \
+        im[ylo][:, xhi] * wx[None, :, None]
+    bot = im[yhi][:, xlo] * (1 - wx)[None, :, None] + \
+        im[yhi][:, xhi] * wx[None, :, None]
+    return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]

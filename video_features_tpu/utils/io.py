@@ -175,8 +175,19 @@ class VideoSource:
         return self.num_frames
 
     def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
-        """Yield (rgb_frame, timestamp_ms, out_index) sequentially."""
+        """Yield (frame, timestamp_ms, out_index) sequentially.
+
+        Frames have ``self.transform`` applied (when set), exactly like the
+        batched ``__iter__`` path — the two views must agree or per-frame
+        resize/crop would silently be skipped for one of them.
+        """
         stream = _FrameStream(self.path)
+        tf = self.transform
+
+        def emit(rgb, out_idx):
+            x = tf(rgb) if tf is not None else rgb
+            return x, out_idx / self.fps * 1000.0, out_idx
+
         try:
             if self.index_map is None:
                 out_idx = 0
@@ -184,7 +195,7 @@ class VideoSource:
                     rgb = stream.read()
                     if rgb is None:
                         return
-                    yield rgb, out_idx / self.fps * 1000.0, out_idx
+                    yield emit(rgb, out_idx)
                     out_idx += 1
             else:
                 src_idx = -1
@@ -193,19 +204,18 @@ class VideoSource:
                     while src_idx < want:
                         nxt = stream.read()
                         if nxt is None:
-                            if out_idx < len(self.index_map) - 1:
-                                # container metadata overstated the frame
-                                # count; the resampled output is shorter than
-                                # planned (decoded frames are still correct)
-                                print(f"Warning: {self.path} ended after "
-                                      f"{src_idx + 1} frames (metadata said "
-                                      f"{self.src_num_frames}); emitted "
-                                      f"{out_idx}/{len(self.index_map)} "
-                                      "resampled frames.")
+                            # container metadata overstated the frame count;
+                            # reaching stream end inside this loop always
+                            # means the resampled output is short
+                            print(f"Warning: {self.path} ended after "
+                                  f"{src_idx + 1} frames (metadata said "
+                                  f"{self.src_num_frames}); emitted "
+                                  f"{out_idx}/{len(self.index_map)} "
+                                  "resampled frames.")
                             return
                         current = nxt
                         src_idx += 1
-                    yield current, out_idx / self.fps * 1000.0, out_idx
+                    yield emit(current, out_idx)
         finally:
             stream.release()
 
@@ -214,8 +224,7 @@ class VideoSource:
         times: List[float] = []
         indices: List[int] = []
         fresh = 0  # frames added since the last yield (excludes carried overlap)
-        for rgb, ts, idx in self.frames():
-            x = self.transform(rgb) if self.transform is not None else rgb
+        for x, ts, idx in self.frames():  # frames() already applies transform
             batch.append(x)
             times.append(ts)
             indices.append(idx)

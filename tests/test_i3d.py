@@ -1,0 +1,87 @@
+"""I3D: parity against the actual reference torch model (imported read-only
+from /root/reference as the numerical oracle) + E2E rgb extraction."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.models import i3d as i3d_model  # noqa: E402
+from tests.torch_oracles import randomize_bn_stats  # noqa: E402
+
+REF_I3D = "/root/reference/models/i3d/i3d_src/i3d_net.py"
+
+
+def _load_reference_i3d():
+    if not os.path.exists(REF_I3D):
+        pytest.skip("reference I3D source not available")
+    spec = importlib.util.spec_from_file_location("ref_i3d", REF_I3D)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("modality,in_ch", [("rgb", 3), ("flow", 2)])
+def test_flax_matches_reference_torch(modality, in_ch):
+    ref = _load_reference_i3d()
+    torch.manual_seed(0)
+    oracle = ref.I3D(num_classes=400, modality=modality).eval()
+    randomize_bn_stats(oracle)
+    params = i3d_model.params_from_torch(oracle.state_dict())
+    model = i3d_model.I3D(num_classes=400)
+
+    # T=18 exercises ceil_mode in BOTH strided 3D maxpools (T: 18 -> 9 ->
+    # ceil -> 5 -> ceil -> 3) — the floor-mode result would be a different
+    # shape, so a pooling bug cannot hide
+    x = np.random.default_rng(1).uniform(
+        low=-1, high=1, size=(1, 18, 224, 224, in_ch)).astype(np.float32)
+    xt = torch.from_numpy(x).permute(0, 4, 1, 2, 3)
+    with torch.no_grad():
+        want_feats = oracle(xt, features=True).numpy()
+        want_softmax, want_logits = oracle(xt, features=False)
+        want_logits = want_logits.numpy()
+    got_feats = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                       features=True))
+    got_logits = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                        features=False))
+    assert got_feats.shape == want_feats.shape == (1, 1024)
+    np.testing.assert_allclose(got_feats, want_feats, atol=5e-4, rtol=5e-4)
+    assert got_logits.shape == want_logits.shape == (1, 400)
+    np.testing.assert_allclose(got_logits, want_logits, atol=5e-4, rtol=5e-4)
+
+
+def test_tf_same_pads_match_reference_formula():
+    ref = _load_reference_i3d()
+    for kernel, stride in [((7, 7, 7), (2, 2, 2)), ((3, 3, 3), (1, 1, 1)),
+                           ((1, 3, 3), (1, 2, 2)), ((2, 2, 2), (2, 2, 2)),
+                           ((3, 3, 3), (2, 2, 2)), ((1, 1, 1), (1, 1, 1))]:
+        # reference returns (Hlo,Hhi,Wlo,Whi,Tlo,Thi) for ConstantPad3d
+        # (last-dim-first); ours is ((Tlo,Thi),(Hlo,Hhi),(Wlo,Whi))
+        hlo, hhi, wlo, whi, tlo, thi = ref.get_padding_shape(kernel, stride)
+        assert i3d_model.tf_same_pads(kernel, stride) == \
+            ((tlo, thi), (hlo, hhi), (wlo, whi))
+
+
+def test_end_to_end_rgb_extraction(sample_video, tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    cfg = load_config("i3d", {
+        "video_paths": sample_video, "device": "cpu", "streams": "rgb",
+        "stack_size": 16, "step_size": 16, "extraction_fps": 6,
+        "clip_batch_size": 2,
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractI3D(cfg)
+    feats = ex._extract(sample_video)
+    # ~18.1s @6fps = ~109 frames; stacks need 17 frames, step 16 ->
+    # stacks complete at frames 17, 33, ..., 97 -> 6 stacks
+    assert feats["rgb"].shape == (6, 1024)
+    assert feats["timestamps_ms"].shape == (6,)
+    assert ex.output_feat_keys == ["rgb", "fps", "timestamps_ms"]

@@ -1,0 +1,22 @@
+"""I3D flow stream: RAFT/PWC flow -> flow-quantization transforms -> I3D.
+
+Composes the flow models (models/raft.py, models/pwc.py) into ExtractI3D,
+mirroring reference models/i3d/extract_i3d.py:151-157 (flow computed between
+consecutive frames of the resized, *uncropped* stack) and the flow transform
+chain TensorCenterCrop(224) -> Clamp(-20, 20) -> ToUInt8 -> ScaleTo1_1
+(extract_i3d.py:53-59).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class FlowStream:
+    def __init__(self, parent, args, mesh, dtype, weights_path,
+                 allow_random) -> None:
+        raise NotImplementedError(
+            "I3D flow stream requires the RAFT/PWC flow models; "
+            "run with streams=rgb until they land")
+
+    def run(self, group: np.ndarray) -> np.ndarray:
+        raise NotImplementedError

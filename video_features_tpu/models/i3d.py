@@ -1,0 +1,212 @@
+"""I3D (Inception-v1 inflated to 3D, Kinetics-400) as a Flax module, NDHWC.
+
+Parity target: the reference's I3D (reference models/i3d/i3d_src/i3d_net.py,
+the hassony2/kinetics_i3d_pytorch port of DeepMind's TF weights):
+
+  - ``Unit3Dpy`` = Conv3d + BatchNorm3d + ReLU with **TensorFlow SAME
+    padding computed from kernel/stride only** (`get_padding_shape`,
+    i3d_net.py:8-25): per dim ``pad_along = max(k - s, 0)``, split
+    ``(pad_along // 2, pad_along - pad_along // 2)``. This is
+    input-size-independent — it is NOT true TF SAME (which depends on
+    ``size % stride``); we replicate the reference's formula exactly.
+  - ``MaxPool3dTFPadding`` (i3d_net.py:108-120) zero-pads explicitly with
+    that same shape then max-pools with ``ceil_mode=True``. Zero padding is
+    observable: inputs are in [-1, 1], so padded zeros can win the max at
+    the borders. Ceil mode lets the last window overhang the right edge
+    (overhang cells never win — replicated here with -inf edge padding).
+  - 9 ``Mixed`` inception blocks (i3d_net.py:123-157, wiring :205-224),
+    channels ``[b0, b1red, b1out, b2red, b2out, b3proj]``.
+  - Head: AvgPool3d((2,7,7), stride 1) (i3d_net.py:226); ``features=True``
+    squeezes spatial dims and means over time -> (B, 1024)
+    (i3d_net.py:259-264); otherwise a 1x1x1 conv classifier -> time-mean
+    logits (+softmax) (i3d_net.py:266-274).
+
+Weight transplant: :func:`params_from_torch` maps the
+``i3d_rgb.pt`` / ``i3d_flow.pt`` state_dicts (keys like
+``mixed_3b.branch_1.0.conv3d.weight``) onto this tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .common import BNInf
+from ..weights import torch_import as ti
+
+FEATURE_DIM = 1024
+
+
+def tf_same_pads(kernel: Sequence[int],
+                 stride: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
+    """Reference `get_padding_shape` (i3d_net.py:8-25) as (lo, hi) pairs.
+
+    Returned in (T, H, W) order (the reference builds H,W,T then rotates the
+    T pair to the back only because torch's ConstantPad3d wants it last —
+    the per-dimension amounts are identical).
+    """
+    out = []
+    for k, s in zip(kernel, stride):
+        pad_along = max(k - s, 0)
+        lo = pad_along // 2
+        out.append((lo, pad_along - lo))
+    return tuple(out)
+
+
+def max_pool_tf_ceil(x: jnp.ndarray, window: Sequence[int],
+                     strides: Sequence[int]) -> jnp.ndarray:
+    """MaxPool3dTFPadding semantics (i3d_net.py:108-120) on NDHWC.
+
+    Explicit zero padding (padded zeros participate in the max, exactly like
+    torch's ConstantPad3d + unpadded MaxPool3d), then ceil-mode pooling: any
+    extra right-edge cells needed to reach the ceil output length are -inf so
+    they never win.
+    """
+    pads = tf_same_pads(window, strides)
+    x = jnp.pad(x, ((0, 0), *pads, (0, 0)))
+    extra = []
+    for i, (k, s) in enumerate(zip(window, strides)):
+        size = x.shape[1 + i]
+        n_out = -(-(size - k) // s) + 1  # ceil((size-k)/s) + 1
+        extra.append((0, max((n_out - 1) * s + k - size, 0)))
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, *window, 1), (1, *strides, 1), ((0, 0), *extra, (0, 0)))
+
+
+class Unit3D(nn.Module):
+    """Conv3d + (BN) + (ReLU) with the reference's SAME padding rule."""
+    features: int
+    kernel: Tuple[int, int, int] = (1, 1, 1)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    use_bias: bool = False
+    use_bn: bool = True
+    relu: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        pads = tf_same_pads(self.kernel, self.stride)
+        x = nn.Conv(self.features, self.kernel, strides=self.stride,
+                    padding=pads, use_bias=self.use_bias, name="conv")(x)
+        if self.use_bn:
+            x = BNInf(name="bn")(x)  # torch BatchNorm3d default eps=1e-5
+        return nn.relu(x) if self.relu else x
+
+
+class Mixed(nn.Module):
+    """Inception block (i3d_net.py:123-157)."""
+    channels: Tuple[int, int, int, int, int, int]
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b0c, b1r, b1c, b2r, b2c, b3c = self.channels
+        b0 = Unit3D(b0c, name="branch_0")(x)
+        b1 = Unit3D(b1r, name="branch_1_0")(x)
+        b1 = Unit3D(b1c, (3, 3, 3), name="branch_1_1")(b1)
+        b2 = Unit3D(b2r, name="branch_2_0")(x)
+        b2 = Unit3D(b2c, (3, 3, 3), name="branch_2_1")(b2)
+        b3 = max_pool_tf_ceil(x, (3, 3, 3), (1, 1, 1))
+        b3 = Unit3D(b3c, name="branch_3_1")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+MIXED_CHANNELS = {
+    "mixed_3b": (64, 96, 128, 16, 32, 32),
+    "mixed_3c": (128, 128, 192, 32, 96, 64),
+    "mixed_4b": (192, 96, 208, 16, 48, 64),
+    "mixed_4c": (160, 112, 224, 24, 64, 64),
+    "mixed_4d": (128, 128, 256, 24, 64, 64),
+    "mixed_4e": (112, 144, 288, 32, 64, 64),
+    "mixed_4f": (256, 160, 320, 32, 128, 128),
+    "mixed_5b": (256, 160, 320, 32, 128, 128),
+    "mixed_5c": (384, 192, 384, 48, 128, 128),
+}
+
+
+class I3D(nn.Module):
+    """(N, T, 224, 224, C) float in [-1, 1] -> (N, 1024) features or
+    (N, num_classes) logits. C=3 for the rgb stream, C=2 for flow."""
+    num_classes: int = 400
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, features: bool = True) -> jnp.ndarray:
+        x = Unit3D(64, (7, 7, 7), (2, 2, 2), name="conv3d_1a_7x7")(x)
+        x = max_pool_tf_ceil(x, (1, 3, 3), (1, 2, 2))
+        x = Unit3D(64, name="conv3d_2b_1x1")(x)
+        x = Unit3D(192, (3, 3, 3), name="conv3d_2c_3x3")(x)
+        x = max_pool_tf_ceil(x, (1, 3, 3), (1, 2, 2))
+        x = Mixed(MIXED_CHANNELS["mixed_3b"], name="mixed_3b")(x)
+        x = Mixed(MIXED_CHANNELS["mixed_3c"], name="mixed_3c")(x)
+        x = max_pool_tf_ceil(x, (3, 3, 3), (2, 2, 2))
+        for name in ("mixed_4b", "mixed_4c", "mixed_4d", "mixed_4e",
+                     "mixed_4f"):
+            x = Mixed(MIXED_CHANNELS[name], name=name)(x)
+        x = max_pool_tf_ceil(x, (2, 2, 2), (2, 2, 2))
+        x = Mixed(MIXED_CHANNELS["mixed_5b"], name="mixed_5b")(x)
+        x = Mixed(MIXED_CHANNELS["mixed_5c"], name="mixed_5c")(x)
+
+        # AvgPool3d((2, 7, 7), stride 1) (i3d_net.py:226): a sliding window
+        # that must fit — same precondition as torch (raises when T' < 2 or
+        # spatial < 7, i.e. crop < 224)
+        t, h, w = x.shape[1:4]
+        if t < 2 or h < 7 or w < 7:
+            raise ValueError(
+                f"I3D head needs a (2,7,7) pool window, got {(t, h, w)}; "
+                "use stack_size >= 10 and 224x224 crops")
+        x = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, 2, 7, 7, 1), (1, 1, 1, 1, 1),
+            [(0, 0)] * 5) / (2 * 7 * 7)
+
+        if features:
+            # squeeze spatial, mean time (i3d_net.py:259-264)
+            return jnp.mean(x[:, :, 0, 0, :], axis=1)
+        x = Unit3D(self.num_classes, use_bias=True, use_bn=False,
+                   relu=False, name="conv3d_0c_1x1")(x)
+        logits = jnp.mean(x[:, :, 0, 0, :], axis=1)
+        return logits  # reference also returns softmax; callers softmax
+
+
+_BN_LEAF = {"weight": "scale", "bias": "bias",
+            "running_mean": "mean", "running_var": "var"}
+
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Reference i3d_{rgb,flow}.pt state_dict -> Flax tree.
+
+    torch keys: ``<block>.conv3d.{weight,bias}``, ``<block>.batch3d.*`` where
+    block is ``conv3d_1a_7x7`` | ``mixed_Xy.branch_N[.i]`` | ``conv3d_0c_1x1``.
+    """
+    params: Dict[str, Any] = {}
+    for key, tensor in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        module, leaf = parts[-2], parts[-1]
+        blocks = parts[:-2]
+        # torch Sequential branches: branch_1.0 -> our branch_1_0;
+        # plain branch_0 stays (no Sequential index)
+        if len(blocks) == 3:
+            blocks = [blocks[0], f"{blocks[1]}_{blocks[2]}"]
+        prefix = "/".join(blocks)
+        if module == "conv3d":
+            if leaf == "weight":
+                ti.set_in(params, f"{prefix}/conv/kernel",
+                          ti.conv3d_kernel(tensor))
+            else:
+                ti.set_in(params, f"{prefix}/conv/bias", ti.to_np(tensor))
+        elif module == "batch3d":
+            ti.set_in(params, f"{prefix}/bn/{_BN_LEAF[leaf]}",
+                      ti.to_np(tensor))
+        else:
+            raise ValueError(f"unexpected I3D key {key}")
+    return params
+
+
+def init_params(modality: str = "rgb", num_classes: int = 400) -> Dict[str, Any]:
+    model = I3D(num_classes)
+    c = 3 if modality == "rgb" else 2
+    v = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 16, 224, 224, c)), features=False)
+    return v["params"]

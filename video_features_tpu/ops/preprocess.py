@@ -133,8 +133,9 @@ def bilinear_resize_by_scale(img: np.ndarray, scale: float) -> np.ndarray:
     ylo, yhi, wy = _bilinear_axis_weights(oh, h, scale)
     xlo, xhi, wx = _bilinear_axis_weights(ow, w, scale)
     im = img.astype(np.float32)
-    top = im[ylo][:, xlo] * (1 - wx)[None, :, None] + \
-        im[ylo][:, xhi] * wx[None, :, None]
-    bot = im[yhi][:, xlo] * (1 - wx)[None, :, None] + \
-        im[yhi][:, xhi] * wx[None, :, None]
+    rows_lo, rows_hi = im[ylo], im[yhi]
+    top = rows_lo[:, xlo] * (1 - wx)[None, :, None] + \
+        rows_lo[:, xhi] * wx[None, :, None]
+    bot = rows_hi[:, xlo] * (1 - wx)[None, :, None] + \
+        rows_hi[:, xhi] * wx[None, :, None]
     return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]

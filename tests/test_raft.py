@@ -1,0 +1,127 @@
+"""RAFT: parity against the actual reference torch model (imported read-only
+from /root/reference as the numerical oracle)."""
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.models import raft as raft_model  # noqa: E402
+
+if "/root/reference" not in sys.path:
+    sys.path.insert(0, "/root/reference")
+
+
+def _ref_raft():
+    try:
+        from models.raft.raft_src.raft import RAFT as RefRAFT
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"reference RAFT not importable: {e}")
+    torch.manual_seed(0)
+    m = RefRAFT().eval()
+    # give the cnet BNs non-trivial running stats so converter bugs show
+    g = torch.Generator().manual_seed(1)
+    for mod in m.modules():
+        if isinstance(mod, torch.nn.BatchNorm2d):
+            mod.running_mean.copy_(
+                torch.rand(mod.running_mean.shape, generator=g) - 0.5)
+            mod.running_var.copy_(
+                torch.rand(mod.running_var.shape, generator=g) + 0.5)
+    return m
+
+
+def test_flax_matches_reference_torch():
+    oracle = _ref_raft()
+    params = raft_model.params_from_torch(oracle.state_dict())
+    model = raft_model.RAFT(iters=20)
+
+    # >=128 px per side: the reference's bilinear_sampler divides by
+    # (W-1) per pyramid level, so a 1x1 level (inputs < 128) NaNs even in
+    # torch; 128x160 -> levels 16x20, 8x10, 4x5, 2x2
+    rng = np.random.default_rng(2)
+    img1 = rng.uniform(0, 255, size=(1, 128, 160, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, size=(1, 128, 160, 3)).astype(np.float32)
+    t1 = torch.from_numpy(img1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(img2).permute(0, 3, 1, 2)
+    with torch.no_grad():
+        want = oracle(t1, t2).permute(0, 2, 3, 1).numpy()  # (B, H, W, 2)
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(img1),
+                                 jnp.asarray(img2)))
+    assert got.shape == want.shape == (1, 128, 160, 2)
+    # 20 recurrent iterations amplify fp noise; flows here are O(1-10) px
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_input_padder_pad_amounts():
+    # pad to /8, sintel mode splits evenly (reference raft.py:30-40)
+    x = np.zeros((1, 436, 1024, 3))
+    (t, b), (l, r) = raft_model.pad_to_multiple(x)
+    assert (t + b) == (440 - 436) and (l, r) == (0, 0)
+    assert t == 2 and b == 2
+    x = np.zeros((1, 48, 64, 3))
+    assert raft_model.pad_to_multiple(x) == ((0, 0), (0, 0))
+
+
+def test_corr_pyramid_and_lookup_match_torch():
+    """Level shapes + the lookup itself vs the reference CorrBlock."""
+    from models.raft.raft_src.corr import CorrBlock
+
+    rng = np.random.default_rng(0)
+    f1 = rng.standard_normal((1, 16, 20, 32)).astype(np.float32)
+    f2 = rng.standard_normal((1, 16, 20, 32)).astype(np.float32)
+    pyr = raft_model.build_corr_pyramid(jnp.asarray(f1), jnp.asarray(f2))
+    assert [p.shape for p in pyr] == [
+        (1, 320, 16, 20), (1, 320, 8, 10), (1, 320, 4, 5), (1, 320, 2, 2)]
+
+    # fractional coords exercise the bilinear weights and border clipping
+    gx, gy = np.meshgrid(np.arange(20.0), np.arange(16.0))
+    coords = (np.stack([gx, gy], axis=-1)[None] +
+              rng.uniform(-2, 2, size=(1, 16, 20, 2))).astype(np.float32)
+    got = np.asarray(raft_model.corr_lookup(pyr, jnp.asarray(coords)))
+
+    t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+    blk = CorrBlock(t1, t2)
+    tc = torch.from_numpy(coords).permute(0, 3, 1, 2)
+    want = blk(tc).permute(0, 2, 3, 1).numpy()
+    assert got.shape == want.shape == (1, 16, 20, 4 * 81)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_end_to_end_extraction(sample_video, tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.raft import ExtractRAFT
+
+    cfg = load_config("raft", {
+        "video_paths": sample_video, "device": "cpu",
+        "batch_size": 4, "extraction_fps": 1, "side_size": 128,
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractRAFT(cfg)
+    feats = ex._extract(sample_video)
+    # ~18.1s @1fps = 19 frames -> 18 flow pairs; 240x320 -> min side 128
+    # => 128x170, padded to /8 inside jit and unpadded back
+    n, c, h, w = feats["raft"].shape
+    assert (c, h, w) == (2, 128, 170) and n == len(feats["timestamps_ms"]) - 1
+    assert (tmp_path / "out" / "raft" / "v_GGSY1Qvo990_raft.npy").exists()
+
+
+def test_flow_viz_matches_reference():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ref_flow_viz", "/root/reference/utils/flow_viz.py")
+    ref = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref)
+    from video_features_tpu.utils import flow_viz
+
+    np.testing.assert_array_equal(flow_viz.make_colorwheel(),
+                                  ref.make_colorwheel())
+    rng = np.random.default_rng(3)
+    flow = rng.uniform(-12, 12, size=(32, 40, 2)).astype(np.float32)
+    np.testing.assert_array_equal(flow_viz.flow_to_image(flow),
+                                  ref.flow_to_image(flow))

@@ -1,0 +1,112 @@
+"""Pair-wise optical-flow extraction pipeline (RAFT, PWC).
+
+Re-design of reference models/_base/base_flow_extractor.py:17-154:
+
+  host:   streaming decode of ``batch_size + 1`` frames with 1-frame overlap
+          between batches (N+1 frames -> N flows; reference
+          base_flow_extractor.py:77-85), optional PIL edge resize, uint8
+  device: fixed-shape (B, 2, H, W, 3) uint8 pair batch -> replicate-pad to
+          the model's stride multiple -> flow net -> unpad -> (B, H, W, 2)
+
+The reference ships frames to the GPU as float32 and pads with a host-side
+InputPadder; here the 4x-smaller uint8 batch is shipped and both the
+[0,255] cast and the replicate padding run inside the jitted function (pad
+amounts are static under jit). Timestamps: the duplicate overlap timestamp
+between consecutive batches is dropped (base_flow_extractor.py:94-95).
+
+Feature layout parity: the reference stores flows channel-first
+``(N, 2, H, W)`` (``model(...)`` output `.tolist()`ed); we transpose our
+NHWC device output on the host to keep saved arrays byte-compatible.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..parallel.mesh import DataParallelApply
+from ..utils.io import VideoSource
+from ..utils import flow_viz
+from .base import BaseExtractor
+
+
+class OpticalFlowExtractor(BaseExtractor):
+    """Families plug in ``runner`` ((B,2,H,W,3) uint8 -> (B,H,W,2) float)."""
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args)
+        self.batch_size = int(args.get("batch_size") or 1)
+        self.side_size = args.get("side_size")
+        self.resize_to_smaller_edge = bool(args.get("resize_to_smaller_edge",
+                                                    True))
+        self.extraction_fps = args.get("extraction_fps")
+        self.extraction_total = args.get("extraction_total")
+        self.output_feat_keys = [self.feature_type, "fps", "timestamps_ms"]
+        self.runner: Optional[DataParallelApply] = None
+
+        if self.side_size is not None:
+            from ..ops import preprocess as pp
+            side = int(self.side_size)
+            smaller = self.resize_to_smaller_edge
+
+            def transform(rgb: np.ndarray) -> np.ndarray:
+                return pp.pil_resize(rgb, side, to_smaller_edge=smaller)
+
+            self.host_transform: Optional[Callable] = transform
+        else:
+            self.host_transform = None
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        video = VideoSource(
+            video_path,
+            batch_size=self.batch_size + 1,  # N+1 frames -> N flows
+            fps=self.extraction_fps,
+            total=self.extraction_total,
+            transform=self.host_transform,
+            overlap=1,
+        )
+        vid_feats: List[np.ndarray] = []
+        timestamps_ms: List[float] = []
+        first = True
+        for batch, ts, _ in video:
+            if len(batch) < 2:
+                # a single-frame video (or trailing lone frame in the first
+                # batch) yields no pairs
+                timestamps_ms.extend(ts if first else ts[1:])
+                first = False
+                continue
+            arr = np.stack(batch)  # (n, H, W, 3) uint8
+            pairs = np.stack([arr[:-1], arr[1:]], axis=1)
+            flows = self.runner(pairs)  # (n-1, H, W, 2) float32
+            self.maybe_show_pred(flows, arr)
+            vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
+            timestamps_ms.extend(ts if first else ts[1:])
+            first = False
+        return {
+            self.feature_type: np.array(vid_feats),
+            "fps": np.array(video.fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }
+
+    def maybe_show_pred(self, flows: np.ndarray, rgb_batch: np.ndarray) -> None:
+        """Reference base_flow_extractor.py:139-154: show each flow frame
+        under its first RGB frame in a cv2 window; headless fallback writes
+        PNGs into tmp_path."""
+        if not self.show_pred:
+            return
+        import cv2
+        from pathlib import Path
+        for i, flow in enumerate(flows):  # flows: (n, H, W, 2) NHWC
+            img = rgb_batch[i].astype(np.float32)
+            vis = flow_viz.flow_to_image(flow)
+            stacked = np.concatenate([img, vis.astype(np.float32)], axis=0)
+            bgr = stacked[:, :, ::-1] / 255.0
+            try:
+                cv2.imshow("Press any key to see the next frame...", bgr)
+                cv2.waitKey()
+            except cv2.error:
+                out = Path(self.tmp_path) / f"flow_pred_{i}.png"
+                out.parent.mkdir(parents=True, exist_ok=True)
+                cv2.imwrite(str(out), (bgr * 255).astype(np.uint8))
+                print(f"show_pred: no display; wrote {out}")

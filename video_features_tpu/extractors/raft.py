@@ -1,0 +1,52 @@
+"""RAFT flow extractor.
+
+Parity target: reference models/raft/extract_raft.py (+ base_flow_extractor):
+sintel/kitti checkpoints, optional edge resize, replicate pad to /8
+(InputPadder 'sintel' mode) before the net and unpad after
+(base_flow_extractor.py:90, 108-114).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..models import raft as raft_model
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..weights import store
+from .flow import OpticalFlowExtractor
+
+
+def _raft_forward(model: raft_model.RAFT, params, pairs_u8):
+    """(B, 2, H, W, 3) uint8 -> (B, H, W, 2) flow; pad/unpad inside jit."""
+    x = pairs_u8.astype(jnp.float32)
+    (pt, pb), (pl, pr) = raft_model.pad_to_multiple(x[:, 0])
+    img1 = jnp.pad(x[:, 0], ((0, 0), (pt, pb), (pl, pr), (0, 0)),
+                   mode="edge")
+    img2 = jnp.pad(x[:, 1], ((0, 0), (pt, pb), (pl, pr), (0, 0)),
+                   mode="edge")
+    flow = model.apply({"params": params}, img1, img2)
+    hp, wp = flow.shape[1], flow.shape[2]
+    return flow[:, pt:hp - pb, pl:wp - pr, :].astype(jnp.float32)
+
+
+class ExtractRAFT(OpticalFlowExtractor):
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args)
+        finetuned_on = args.get("finetuned_on", "sintel")
+        if finetuned_on not in ("sintel", "kitti"):
+            raise NotImplementedError(
+                f"finetuned_on={finetuned_on!r}; reference supports "
+                "sintel/kitti (extract_raft.py:6-9)")
+        self.model = raft_model.RAFT(iters=raft_model.ITERS)
+        params = store.resolve_params(
+            f"raft_{finetuned_on}", raft_model.init_params,
+            raft_model.params_from_torch,
+            weights_path=args.get("weights_path"),
+            allow_random=bool(args.get("allow_random_weights", False)))
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_raft_forward, self.model), params, mesh=mesh,
+            fixed_batch=self.batch_size)

@@ -31,6 +31,14 @@ HUB_FILENAMES: Dict[str, tuple] = {
     "r2plus1d_18_16_kinetics": ("r2plus1d_18-91a641e6.pth",),
     "r2plus1d_34_32_ig65m_ft_kinetics": ("r2plus1d_34_clip32_ig65m_from_scratch-449a7af9.pth",),
     "r2plus1d_34_8_ig65m_ft_kinetics": ("r2plus1d_34_clip8_ig65m_from_scratch-9bae36ae.pth",),
+    # repo-local checkpoints in the reference (SURVEY §2.5); same filenames
+    # accepted if dropped into VFT_WEIGHTS_DIR
+    "raft_sintel": ("raft-sintel.pth",),
+    "raft_kitti": ("raft-kitti.pth",),
+    "i3d_rgb": ("i3d_rgb.pt",),
+    "i3d_flow": ("i3d_flow.pt",),
+    "s3d_kinetics400": ("S3D_kinetics400_torchified.pt",),
+    "pwc_sintel": ("pwc_net_sintel.pt",),
 }
 
 
@@ -55,9 +63,11 @@ def find_checkpoint(model_key: str,
     torch_home = Path(os.environ.get("TORCH_HOME",
                                      os.path.expanduser("~/.cache/torch")))
     for fname in HUB_FILENAMES.get(model_key, ()):
-        p = torch_home / "hub" / "checkpoints" / fname
-        if p.exists():
-            return p
+        # original upstream filenames are accepted both in the torch hub
+        # cache and dropped directly into VFT_WEIGHTS_DIR
+        for p in (torch_home / "hub" / "checkpoints" / fname, wd / fname):
+            if p.exists():
+                return p
     return None
 
 

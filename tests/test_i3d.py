@@ -85,3 +85,65 @@ def test_end_to_end_rgb_extraction(sample_video, tmp_path):
     assert feats["rgb"].shape == (6, 1024)
     assert feats["timestamps_ms"].shape == (6,)
     assert ex.output_feat_keys == ["rgb", "fps", "timestamps_ms"]
+
+
+def test_flow_quantize_chain_matches_reference_transforms():
+    """The jitted RAFT-side transform tail (crop of the padded field, clamp,
+    ToUInt8) + the I3D-side ScaleTo1_1 vs the reference torch Compose
+    (extract_i3d.py:53-59). Uses a synthetic flow field so only the transform
+    semantics (floor-rule crop, round-half-to-even float quantization) are
+    under test — RAFT itself has its own parity test."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_transforms", "/root/reference/models/transforms.py")
+    ref = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref)
+
+    rng = np.random.default_rng(7)
+    # flow values straddling the clamp boundary, incl. exact +/-20 -> the
+    # 255.5 -> 256 round-half-even edge, at an odd padded size (261x349) so
+    # the center-crop floor rule is exercised
+    flow = rng.uniform(-25, 25, size=(3, 2, 261, 349)).astype(np.float32)
+    flow[0, 0, 0, 0] = 20.0
+    flow[0, 1, 0, 1] = -20.0
+
+    want = ref.TensorCenterCrop(224)(torch.from_numpy(flow))
+    want = ref.Clamp(-20, 20)(want)
+    want = ref.ToUInt8()(want)
+    want = ref.ScaleTo1_1()(want).numpy()
+
+    # ours: NHWC; crop+clamp+quantize as in _raft_quantized_flow, scale as
+    # in _i3d_flow_forward
+    x = jnp.asarray(flow.transpose(0, 2, 3, 1))
+    hp, wp = x.shape[1], x.shape[2]
+    i, j = (hp - 224) // 2, (wp - 224) // 2
+    q = jnp.round(128.0 + 255.0 / 40.0 * jnp.clip(x[:, i:i + 224, j:j + 224],
+                                                  -20.0, 20.0))
+    got = np.asarray(q * (2.0 / 255.0) - 1.0).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_end_to_end_two_stream_extraction(sample_video, tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    cfg = load_config("i3d", {
+        "video_paths": sample_video, "device": "cpu",
+        "stack_size": 10, "step_size": 10, "extraction_fps": 1,
+        "clip_batch_size": 1,
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractI3D(cfg)
+    feats = ex._extract(sample_video)
+    # ~18.1s @1fps = 19 frames; a stack needs 11 frames, step 10 -> one
+    # stack completes at frame 11 (next would need frame 21 > 19)
+    assert ex.output_feat_keys == ["rgb", "flow", "fps", "timestamps_ms"]
+    assert feats["rgb"].shape == (1, 1024)
+    assert feats["flow"].shape == (1, 1024)
+    assert feats["timestamps_ms"].shape == (1,)
+    out_dir = tmp_path / "out" / "i3d"
+    assert (out_dir / "v_GGSY1Qvo990_rgb.npy").exists()
+    assert (out_dir / "v_GGSY1Qvo990_flow.npy").exists()

@@ -34,8 +34,9 @@ from ..weights import store
 from .base import BaseExtractor
 
 
-def _i3d_rgb_forward(model: i3d_model.I3D, dtype, features, params, batch):
-    # batch: (B, T, 224, 224, 3) uint8 -> ScaleTo1_1 (transforms.py:146-149)
+def _i3d_forward(model: i3d_model.I3D, dtype, features, params, batch):
+    # batch: (B, T, 224, 224, C) — uint8 rgb or quantized-flow floats in
+    # [0, 256]; both streams share ScaleTo1_1 (transforms.py:146-149)
     x = batch.astype(dtype)
     x = x * (2.0 / 255.0) - 1.0
     return model.apply({"params": params}, x,
@@ -75,15 +76,14 @@ class ExtractI3D(BaseExtractor):
                 i3d_model.params_from_torch, weights_path=weights_path,
                 allow_random=allow_random)
             self.runners["rgb"] = DataParallelApply(
-                partial(_i3d_rgb_forward, self.model, dtype, True),
+                partial(_i3d_forward, self.model, dtype, True),
                 params, mesh=mesh, fixed_batch=self.clip_batch_size)
             if self.show_pred:
                 self.logits_runners["rgb"] = DataParallelApply(
-                    partial(_i3d_rgb_forward, self.model, dtype, False),
+                    partial(_i3d_forward, self.model, dtype, False),
                     params, mesh=mesh, fixed_batch=self.clip_batch_size)
         if "flow" in self.streams:
-            self._init_flow_stream(args, mesh, dtype, weights_path,
-                                   allow_random)
+            self._init_flow_stream(args, mesh, dtype, allow_random)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             # ResizeImproved(256) smaller-edge PIL bilinear, kept uint8
@@ -92,11 +92,10 @@ class ExtractI3D(BaseExtractor):
 
         self.host_transform = transform
 
-    def _init_flow_stream(self, args, mesh, dtype, weights_path,
-                          allow_random) -> None:
+    def _init_flow_stream(self, args, mesh, dtype, allow_random) -> None:
         from . import i3d_flow
         self._flow_stream = i3d_flow.FlowStream(
-            self, args, mesh, dtype, weights_path, allow_random)
+            self, args, mesh, dtype, allow_random)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
@@ -105,16 +104,18 @@ class ExtractI3D(BaseExtractor):
         stacks: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         feats: Dict[str, List] = {s: [] for s in self.streams}
-        self._stack_counter = 0
+        stacks_done = 0
 
         def flush():
+            nonlocal stacks_done
             if not stacks:
                 return
             group = np.stack(stacks)  # (G, T+1, H, W, 3) uint8
             stacks.clear()
             for stream in self.streams:
-                out = self.run_stream(stream, group)
+                out = self.run_stream(stream, group, stacks_done)
                 feats[stream].extend(list(out))
+            stacks_done += len(group)
 
         for frame, _, idx in src.frames():
             frames.append(frame)
@@ -132,8 +133,14 @@ class ExtractI3D(BaseExtractor):
         out["timestamps_ms"] = np.array(timestamps_ms)
         return out
 
-    def run_stream(self, stream: str, group: np.ndarray) -> np.ndarray:
-        """group: (G, stack+1, H, W, 3) uint8 resized frames -> (G, 1024)."""
+    def run_stream(self, stream: str, group: np.ndarray,
+                   stack_base: int) -> np.ndarray:
+        """group: (G, stack+1, H, W, 3) uint8 resized frames -> (G, 1024).
+
+        ``stack_base`` = stacks already processed before this group, so both
+        streams print the same stack indices under show_pred (the reference
+        threads one stack_counter through run_on_a_stack, extract_i3d.py:140).
+        """
         if stream == "rgb":
             # crop on host (pure slice, parity-exact; 30% less H2D traffic),
             # drop the +1 frame the flow stream needs (extract_i3d.py:158-159)
@@ -142,16 +149,15 @@ class ExtractI3D(BaseExtractor):
             j = (group.shape[3] - c) // 2
             g = group[:, :-1, i:i + c, j:j + c]
             out = self.runners["rgb"](g)
-            self.maybe_show_pred("rgb", g)
+            self.maybe_show_pred("rgb", g, stack_base)
             return out
-        out = self._flow_stream.run(group)
-        return out
+        return self._flow_stream.run(group, stack_base)
 
-    def maybe_show_pred(self, stream: str, device_in: np.ndarray) -> None:
+    def maybe_show_pred(self, stream: str, device_in: np.ndarray,
+                        stack_base: int) -> None:
         if not self.show_pred:
             return
         logits = self.logits_runners[stream](device_in)
-        for row in np.asarray(logits):
-            print(f"At stack {self._stack_counter} ({stream} stream)")
+        for i, row in enumerate(np.asarray(logits)):
+            print(f"At stack {stack_base + i} ({stream} stream)")
             show_predictions_on_dataset(row[None], "kinetics")
-            self._stack_counter += 1

@@ -1,22 +1,98 @@
-"""I3D flow stream: RAFT/PWC flow -> flow-quantization transforms -> I3D.
+"""I3D flow stream: RAFT flow -> flow-quantization transforms -> I3D.
 
-Composes the flow models (models/raft.py, models/pwc.py) into ExtractI3D,
-mirroring reference models/i3d/extract_i3d.py:151-157 (flow computed between
-consecutive frames of the resized, *uncropped* stack) and the flow transform
-chain TensorCenterCrop(224) -> Clamp(-20, 20) -> ToUInt8 -> ScaleTo1_1
-(extract_i3d.py:53-59).
+Composes the RAFT flow model into ExtractI3D, mirroring reference
+models/i3d/extract_i3d.py:140-169:
+
+  - flow is computed between consecutive frames of the resized, *uncropped*
+    stack; the RAFT path replicate-pads the whole stack to /8 first
+    (``padder.pad(rgb_stack)[:-1]`` vs ``[1:]``, extract_i3d.py:153) and the
+    flow is never unpadded,
+  - so the flow transform chain TensorCenterCrop(224) -> Clamp(-20, 20) ->
+    ToUInt8 -> ScaleTo1_1 (extract_i3d.py:53-59) crops the center of the
+    *padded* flow field,
+  - the quantized flow feeds the 2-channel I3D (Kinetics flow checkpoint).
+
+TPU split of that chain: RAFT + crop + clamp + quantization run in one jitted
+pair-batched program (the D2H transfer is the small (T, 224, 224, 2) crop,
+not the full-resolution field); the [-1, 1] scaling runs inside the jitted
+I3D forward where XLA fuses it into the first conv. ``ToUInt8`` is
+``round(128 + 255/40 * x)`` on *floats* — values can reach 256.0 at the +20
+clamp boundary and torch's round is half-to-even, matching ``jnp.round`` —
+so the intermediate stays float32 rather than an actual uint8 cast
+(reference models/transforms.py:168-176).
+
+The PWC flow path (extract_i3d.py:154-155, no padder) plugs in here once the
+PWC family lands.
 """
 from __future__ import annotations
 
+from functools import partial
+
+import jax.numpy as jnp
 import numpy as np
+
+from ..models import i3d as i3d_model
+from ..models import raft as raft_model
+from ..parallel.mesh import DataParallelApply
+from ..weights import store
+
+
+def _raft_quantized_flow(model: raft_model.RAFT, crop: int, params,
+                         pairs_u8):
+    """(B, 2, H, W, 3) uint8 -> (B, crop, crop, 2) quantized flow floats."""
+    flow, _ = raft_model.padded_flow(model, params,
+                                     pairs_u8.astype(jnp.float32))
+    hp, wp = flow.shape[1], flow.shape[2]
+    i, j = (hp - crop) // 2, (wp - crop) // 2  # TensorCenterCrop floor rule
+    flow = flow[:, i:i + crop, j:j + crop, :]
+    flow = jnp.clip(flow, -20.0, 20.0)
+    return jnp.round(128.0 + 255.0 / 40.0 * flow)
 
 
 class FlowStream:
-    def __init__(self, parent, args, mesh, dtype, weights_path,
-                 allow_random) -> None:
-        raise NotImplementedError(
-            "I3D flow stream requires the RAFT/PWC flow models; "
-            "run with streams=rgb until they land")
 
-    def run(self, group: np.ndarray) -> np.ndarray:
-        raise NotImplementedError
+    def __init__(self, parent, args, mesh, dtype, allow_random) -> None:
+        self.parent = parent
+        crop = parent.central_crop_size
+        if parent.flow_type == "raft":
+            # the reference hardcodes the sintel checkpoint for the i3d flow
+            # sub-model (extract_i3d.py:178)
+            flow_model = raft_model.RAFT(iters=raft_model.ITERS)
+            flow_params = store.resolve_params(
+                "raft_sintel", raft_model.init_params,
+                raft_model.params_from_torch,
+                weights_path=args.get("flow_model_weights_path"),
+                allow_random=allow_random)
+            self.pair_runner = DataParallelApply(
+                partial(_raft_quantized_flow, flow_model, crop), flow_params,
+                mesh=mesh, fixed_batch=parent.stack_size)
+        elif parent.flow_type == "pwc":
+            raise NotImplementedError(
+                "flow_type=pwc arrives with the PWC family")
+        else:
+            raise NotImplementedError(
+                f"flow_type={parent.flow_type!r}; reference supports "
+                "raft/pwc (extract_i3d.py:151-157)")
+
+        from .i3d import _i3d_forward
+        i3d_params = store.resolve_params(
+            "i3d_flow", partial(i3d_model.init_params, "flow"),
+            i3d_model.params_from_torch,
+            weights_path=args.get("flow_weights_path"),
+            allow_random=allow_random)
+        self.runner = DataParallelApply(
+            partial(_i3d_forward, parent.model, dtype, True),
+            i3d_params, mesh=mesh, fixed_batch=parent.clip_batch_size)
+        if parent.show_pred:
+            parent.logits_runners["flow"] = DataParallelApply(
+                partial(_i3d_forward, parent.model, dtype, False),
+                i3d_params, mesh=mesh, fixed_batch=parent.clip_batch_size)
+
+    def run(self, group: np.ndarray, stack_base: int) -> np.ndarray:
+        """group: (G, stack+1, H, W, 3) uint8 resized frames -> (G, 1024)."""
+        quant = [self.pair_runner(np.stack([g[:-1], g[1:]], axis=1))
+                 for g in group]
+        flow_in = np.stack(quant)  # (G, T, 224, 224, 2) float32
+        out = self.runner(flow_in)
+        self.parent.maybe_show_pred("flow", flow_in, stack_base)
+        return out

@@ -20,13 +20,8 @@ from .flow import OpticalFlowExtractor
 
 def _raft_forward(model: raft_model.RAFT, params, pairs_u8):
     """(B, 2, H, W, 3) uint8 -> (B, H, W, 2) flow; pad/unpad inside jit."""
-    x = pairs_u8.astype(jnp.float32)
-    (pt, pb), (pl, pr) = raft_model.pad_to_multiple(x[:, 0])
-    img1 = jnp.pad(x[:, 0], ((0, 0), (pt, pb), (pl, pr), (0, 0)),
-                   mode="edge")
-    img2 = jnp.pad(x[:, 1], ((0, 0), (pt, pb), (pl, pr), (0, 0)),
-                   mode="edge")
-    flow = model.apply({"params": params}, img1, img2)
+    flow, ((pt, pb), (pl, pr)) = raft_model.padded_flow(
+        model, params, pairs_u8.astype(jnp.float32))
     hp, wp = flow.shape[1], flow.shape[2]
     return flow[:, pt:hp - pb, pl:wp - pr, :].astype(jnp.float32)
 

@@ -277,6 +277,21 @@ def pad_to_multiple(x: np.ndarray, mult: int = 8,
     return ((0, pad_ht), (pad_wd // 2, pad_wd - pad_wd // 2))
 
 
+def padded_flow(model: "RAFT", params, pairs_f32: jnp.ndarray,
+                mode: str = "sintel"):
+    """Run RAFT on an (B, 2, H, W, 3) float pair batch with InputPadder
+    semantics (replicate-pad to /8, raft.py:30-48). Returns the flow at
+    *padded* resolution plus the ((top, bottom), (left, right)) pad amounts
+    so callers can unpad (extract_raft) or center-crop the padded field
+    (the I3D flow stream, which never unpads — extract_i3d.py:153)."""
+    (pt, pb), (pl, pr) = pad_to_multiple(pairs_f32[:, 0], mode=mode)
+    pad = ((0, 0), (pt, pb), (pl, pr), (0, 0))
+    flow = model.apply({"params": params},
+                       jnp.pad(pairs_f32[:, 0], pad, mode="edge"),
+                       jnp.pad(pairs_f32[:, 1], pad, mode="edge"))
+    return flow, ((pt, pb), (pl, pr))
+
+
 class RAFT(nn.Module):
     """(B, H, W, 3) [0,255] image pairs -> (B, H, W, 2) flow (pixels)."""
     iters: int = ITERS

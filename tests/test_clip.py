@@ -1,0 +1,199 @@
+"""CLIP: parity against the reference torch model/tokenizer (imported
+read-only from /root/reference as the numerical oracle) + E2E extraction."""
+import importlib.util
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.models import clip as clip_model  # noqa: E402
+from tests.torch_oracles import randomize_bn_stats  # noqa: E402
+
+REF_CLIP_DIR = "/root/reference/models/clip/clip_src"
+
+
+def _load_ref(module_file, name):
+    path = os.path.join(REF_CLIP_DIR, module_file)
+    if not os.path.exists(path):
+        pytest.skip("reference CLIP source not available")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _flax_cfg(embed_dim, res, layers, width, patch, twidth, theads, tlayers,
+              ctx, vocab):
+    return clip_model.CLIPConfig(
+        embed_dim=embed_dim, image_resolution=res, vision_layers=layers,
+        vision_width=width, vision_patch_size=patch, context_length=ctx,
+        vocab_size=vocab, transformer_width=twidth,
+        transformer_heads=theads, transformer_layers=tlayers)
+
+
+def _text_tokens(rng, n, ctx, vocab):
+    """Random token rows whose max sits at a controlled 'eot' position."""
+    toks = rng.integers(1, vocab - 1, size=(n, ctx)).astype(np.int64)
+    for i in range(n):
+        eot = rng.integers(2, ctx)
+        toks[i, eot] = vocab - 1  # strict max -> argmax picks it
+        toks[i, eot + 1:] = 0
+    return toks
+
+
+def test_vit_clip_matches_reference_torch():
+    ref = _load_ref("model.py", "ref_clip_model")
+    torch.manual_seed(0)
+    # tiny ViT-B-shaped model: width 64 (1 head), 2+2 layers, patch 14 on
+    # 56px -> 16+1 tokens, vocab 128, ctx 12
+    oracle = ref.CLIP(embed_dim=32, image_resolution=56, vision_layers=2,
+                      vision_width=64, vision_patch_size=14,
+                      context_length=12, vocab_size=128,
+                      transformer_width=64, transformer_heads=2,
+                      transformer_layers=2).eval()
+    cfg = _flax_cfg(32, 56, 2, 64, 14, 64, 2, 2, 12, 128)
+    params = clip_model.params_from_torch(oracle.state_dict())
+    model = clip_model.CLIP(cfg)
+
+    rng = np.random.default_rng(1)
+    img = rng.normal(size=(3, 56, 56, 3)).astype(np.float32)
+    toks = _text_tokens(rng, 4, 12, 128)
+    with torch.no_grad():
+        want_img = oracle.encode_image(
+            torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+        want_txt = oracle.encode_text(torch.from_numpy(toks)).numpy()
+    got_img = np.asarray(model.apply({"params": params}, jnp.asarray(img),
+                                     method="encode_image"))
+    got_txt = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(toks.astype(np.int32)),
+        method="encode_text"))
+    assert got_img.shape == want_img.shape == (3, 32)
+    np.testing.assert_allclose(got_img, want_img, atol=2e-5, rtol=1e-4)
+    assert got_txt.shape == want_txt.shape == (4, 32)
+    np.testing.assert_allclose(got_txt, want_txt, atol=2e-5, rtol=1e-4)
+
+
+def test_modified_resnet_clip_matches_reference_torch():
+    ref = _load_ref("model.py", "ref_clip_model")
+    torch.manual_seed(2)
+    # RN50-shaped but tiny: width 64 -> embed 2048, attnpool grid 64/32=2,
+    # uneven stage depths exercise the stride placement
+    oracle = ref.CLIP(embed_dim=48, image_resolution=64,
+                      vision_layers=(1, 2, 1, 1), vision_width=64,
+                      vision_patch_size=None, context_length=10,
+                      vocab_size=64, transformer_width=64,
+                      transformer_heads=1, transformer_layers=1).eval()
+    randomize_bn_stats(oracle)
+    cfg = _flax_cfg(48, 64, (1, 2, 1, 1), 64, None, 64, 1, 1, 10, 64)
+    params = clip_model.params_from_torch(oracle.state_dict())
+    model = clip_model.CLIP(cfg)
+
+    rng = np.random.default_rng(3)
+    img = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = oracle.encode_image(
+            torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(img),
+                                 method="encode_image"))
+    assert got.shape == want.shape == (2, 48)
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=1e-4)
+
+
+def test_config_from_state_dict_matches_reference_inference():
+    ref = _load_ref("model.py", "ref_clip_model")
+    torch.manual_seed(4)
+    for kwargs in (
+        dict(embed_dim=32, image_resolution=56, vision_layers=2,
+             vision_width=64, vision_patch_size=14, context_length=12,
+             vocab_size=128, transformer_width=64, transformer_heads=2,
+             transformer_layers=2),
+        dict(embed_dim=48, image_resolution=64, vision_layers=(1, 2, 1, 1),
+             vision_width=64, vision_patch_size=None, context_length=10,
+             vocab_size=64, transformer_width=64, transformer_heads=1,
+             transformer_layers=1),
+    ):
+        sd = ref.CLIP(**kwargs).state_dict()
+        cfg = clip_model.config_from_state_dict(sd)
+        assert cfg.embed_dim == kwargs["embed_dim"]
+        assert cfg.image_resolution == kwargs["image_resolution"]
+        assert tuple(np.atleast_1d(cfg.vision_layers)) == \
+            tuple(np.atleast_1d(kwargs["vision_layers"]))
+        assert cfg.vision_width == kwargs["vision_width"]
+        assert cfg.context_length == kwargs["context_length"]
+        assert cfg.vocab_size == kwargs["vocab_size"]
+        assert cfg.transformer_width == kwargs["transformer_width"]
+        assert cfg.transformer_layers == kwargs["transformer_layers"]
+
+
+REF_BPE = os.path.join(REF_CLIP_DIR, "bpe_simple_vocab_16e6.txt.gz")
+
+
+def test_tokenizer_matches_reference():
+    if not os.path.exists(REF_BPE):
+        pytest.skip("reference BPE vocab not available")
+    # the reference tokenizer imports ftfy (not installed here); its
+    # basic_clean is an identity for already-clean text, so stub it
+    if "ftfy" not in sys.modules:
+        ftfy = types.ModuleType("ftfy")
+        ftfy.fix_text = lambda t: t
+        sys.modules["ftfy"] = ftfy
+    ref_tok_mod = _load_ref("simple_tokenizer.py", "ref_simple_tokenizer")
+    ref_tok = ref_tok_mod.SimpleTokenizer(REF_BPE)
+
+    from video_features_tpu.utils.tokenizer import ClipTokenizer
+    tok = ClipTokenizer(bpe_path=REF_BPE)
+    assert len(tok.encoder) == 49408
+    assert tok.encoder == ref_tok.encoder
+
+    texts = [
+        "a photo of abseiling",
+        "a photo of washing dishes",
+        "Hello, World!  it's a   test...",
+        "hyphenated-words & punctuation?!",
+        "numbers 123 and 42nd",
+        "café naïve déjà vu",  # non-ASCII bytes
+        "I'll we've can't y'all'd've",
+        "",
+    ]
+    for t in texts:
+        assert tok.encode(t) == ref_tok.encode(t), t
+    ids = tok.encode("a photo of juggling balls")
+    assert tok.decode(ids) == ref_tok.decode(ids)
+
+    # fixed-shape tokenize parity incl. sot/eot/padding
+    want = np.zeros((len(texts), 77), dtype=np.int32)
+    for i, t in enumerate(texts):
+        row = [tok.sot_token] + ref_tok.encode(t) + [tok.eot_token]
+        want[i, :len(row)] = row
+    np.testing.assert_array_equal(tok.tokenize(texts), want)
+
+    with pytest.raises(RuntimeError):
+        tok.tokenize(["word " * 100], context_length=16)
+    trunc = tok.tokenize(["word " * 100], context_length=16, truncate=True)
+    assert trunc.shape == (1, 16) and trunc[0, -1] == tok.eot_token
+
+
+def test_end_to_end_extraction(sample_video, tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.clip import ExtractCLIP
+
+    cfg = load_config("clip", {
+        "video_paths": sample_video, "device": "cpu", "batch_size": 8,
+        "extraction_fps": 2, "on_extraction": "save_numpy",
+        "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractCLIP(cfg)
+    feats = ex._extract(sample_video)
+    # ~18.1s @2fps = 37 frames, ViT-B/32 -> 512-d
+    assert feats["clip"].shape == (37, 512)
+    assert feats["timestamps_ms"].shape == (37,)
+    out_dir = tmp_path / "out" / "clip" / "ViT-B_32"
+    assert (out_dir / "v_GGSY1Qvo990_clip.npy").exists()

@@ -95,6 +95,8 @@ def test_flow_quantize_chain_matches_reference_transforms():
     under test — RAFT itself has its own parity test."""
     import importlib.util
 
+    if not os.path.exists("/root/reference/models/transforms.py"):
+        pytest.skip("reference transforms source not available")
     spec = importlib.util.spec_from_file_location(
         "ref_transforms", "/root/reference/models/transforms.py")
     ref = importlib.util.module_from_spec(spec)
@@ -147,3 +149,25 @@ def test_end_to_end_two_stream_extraction(sample_video, tmp_path):
     out_dir = tmp_path / "out" / "i3d"
     assert (out_dir / "v_GGSY1Qvo990_rgb.npy").exists()
     assert (out_dir / "v_GGSY1Qvo990_flow.npy").exists()
+
+
+def test_end_to_end_flow_pwc_extraction(sample_video, tmp_path):
+    """The flow_type=pwc composition path (extract_i3d.py:154-155: no
+    padder, crop on the unpadded input-resolution field)."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    cfg = load_config("i3d", {
+        "video_paths": sample_video, "device": "cpu", "streams": "flow",
+        "flow_type": "pwc",
+        "stack_size": 10, "step_size": 10, "extraction_fps": 1,
+        "clip_batch_size": 1,
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "out"), "tmp_path": str(tmp_path / "tmp"),
+    })
+    sanity_check(cfg)
+    ex = ExtractI3D(cfg)
+    feats = ex._extract(sample_video)
+    assert ex.output_feat_keys == ["flow", "fps", "timestamps_ms"]
+    assert feats["flow"].shape == (1, 1024)
+    assert (tmp_path / "out" / "i3d" / "v_GGSY1Qvo990_flow.npy").exists()

@@ -193,3 +193,32 @@ def randomize_bn_stats(model, seed=0):
         if isinstance(m, (nn.BatchNorm1d, nn.BatchNorm2d, nn.BatchNorm3d)):
             m.running_mean.copy_(torch.rand(m.running_mean.shape, generator=g) - 0.5)
             m.running_var.copy_(torch.rand(m.running_var.shape, generator=g) + 0.5)
+
+
+# ---------------------------------------------------------------------------
+# VGGish (harritaylor/torchvggish layout; state_dict keys features.N /
+# embeddings.N, identical to the reference's vggish_slim.py VGG)
+# ---------------------------------------------------------------------------
+
+class TorchVGGish(nn.Module):
+    def __init__(self):
+        super().__init__()
+        layers, in_ch = [], 1
+        for v in [64, "M", 128, "M", 256, 256, "M", 512, 512, "M"]:
+            if v == "M":
+                layers.append(nn.MaxPool2d(2, 2))
+            else:
+                layers += [nn.Conv2d(in_ch, v, 3, padding=1),
+                           nn.ReLU(inplace=True)]
+                in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.embeddings = nn.Sequential(
+            nn.Linear(512 * 4 * 6, 4096), nn.ReLU(True),
+            nn.Linear(4096, 4096), nn.ReLU(True),
+            nn.Linear(4096, 128), nn.ReLU(True))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = torch.transpose(x, 1, 3)
+        x = torch.transpose(x, 1, 2)
+        return self.embeddings(x.contiguous().view(x.size(0), -1))

@@ -1,0 +1,117 @@
+"""CLIP frame-wise extractor.
+
+Parity target: reference models/clip/extract_clip.py — frame-wise features
+from ``model.encode_image``; transforms built from the model's own input
+resolution (Resize(R, BICUBIC) smaller-edge -> CenterCrop(R) -> ToTensor ->
+Normalize(CLIP mean/std), extract_clip.py:69-78); ``custom`` checkpoints
+infer their architecture from the state_dict (extract_clip.py:55-61 +
+clip_src build_model); ``show_pred`` is zero-shot over "a photo of {label}"
+Kinetics-400 prompts or user ``pred_texts`` (extract_clip.py:32-40, 86-108),
+with the cosine-similarity logits computed in float64 exactly like the
+reference's ``.to(torch.double)``.
+
+Output keys: ``[clip, fps, timestamps_ms]``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models import clip as clip_model
+from ..ops import preprocess as pp
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..utils.labels import KINETICS_CLASS_PATH, show_predictions_on_dataset
+from ..weights import store
+from .frame_wise import FrameWiseExtractor
+
+
+def model_key(model_name: str) -> str:
+    """'ViT-B/32' -> 'clip_ViT-B-32' (matches the OpenAI CDN filenames)."""
+    return "clip_" + model_name.replace("/", "-").replace("@", "-")
+
+
+def _encode_image(model: clip_model.CLIP, dtype, params, batch_u8):
+    """uint8 (B,R,R,3) -> (B,embed): /255, CLIP-normalize, visual tower."""
+    x = batch_u8.astype(jnp.float32) / 255.0
+    x = (x - jnp.asarray(pp.CLIP_MEAN)) / jnp.asarray(pp.CLIP_STD)
+    x = x.astype(dtype)
+    return model.apply({"params": params}, x,
+                       method="encode_image").astype(jnp.float32)
+
+
+class ExtractCLIP(FrameWiseExtractor):
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args)
+        allow_random = bool(args.get("allow_random_weights", False))
+        weights_path = args.get("weights_path")
+
+        if self.model_name == "custom":
+            # architecture comes from the checkpoint itself
+            # (extract_clip.py:55-61; build_model, clip_src/model.py:399-436)
+            if not weights_path:
+                raise FileNotFoundError(
+                    "model_name=custom requires weights_path=<checkpoint>")
+            from ..weights.torch_import import load_torch_state_dict
+            sd = load_torch_state_dict(weights_path)
+            self.cfg = clip_model.config_from_state_dict(sd)
+            params = clip_model.params_from_torch(sd)
+            self.model = clip_model.CLIP(self.cfg)
+        elif self.model_name in clip_model.CONFIGS:
+            self.cfg = clip_model.CONFIGS[self.model_name]
+            self.model = clip_model.CLIP(self.cfg)
+            params = store.resolve_params(
+                model_key(self.model_name),
+                partial(clip_model.init_params, self.model_name),
+                clip_model.params_from_torch,
+                weights_path=weights_path, allow_random=allow_random)
+        else:
+            raise NotImplementedError(f"Model {self.model_name} not found")
+
+        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_encode_image, self.model, dtype), params,
+            mesh=mesh, fixed_batch=self.batch_size)
+
+        input_size = self.cfg.image_resolution
+
+        def transform(rgb: np.ndarray) -> np.ndarray:
+            out = pp.pil_resize(rgb, input_size, interpolation="bicubic")
+            return pp.center_crop(out, input_size)
+
+        self.host_transform = transform
+
+        self._text_feats: Optional[np.ndarray] = None
+        if self.show_pred:
+            pred_texts = args.get("pred_texts")
+            if pred_texts is None:
+                with open(KINETICS_CLASS_PATH) as f:
+                    self.pred_texts: List[str] = [
+                        f"a photo of {x.strip()}" for x in f]
+            else:
+                self.pred_texts = list(pred_texts)
+            from ..utils.tokenizer import ClipTokenizer
+            self._tokens = ClipTokenizer(args.get("bpe_path")).tokenize(
+                self.pred_texts)
+            self._logit_scale = float(np.asarray(params["logit_scale"]))
+            self._encode_text = jax.jit(partial(
+                self.model.apply, {"params": params}, method="encode_text"))
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        if not self.show_pred:
+            return
+        if self._text_feats is None:
+            self._text_feats = np.asarray(
+                self._encode_text(jnp.asarray(self._tokens)))
+        v = feats.astype(np.float64)
+        t = self._text_feats.astype(np.float64)
+        v = v / np.linalg.norm(v, axis=1, keepdims=True)
+        t = t / np.linalg.norm(t, axis=1, keepdims=True)
+        logits = np.exp(self._logit_scale) * v @ t.T
+        show_predictions_on_dataset(logits, self.pred_texts)

@@ -1,6 +1,6 @@
-"""I3D flow stream: RAFT flow -> flow-quantization transforms -> I3D.
+"""I3D flow stream: RAFT/PWC flow -> flow-quantization transforms -> I3D.
 
-Composes the RAFT flow model into ExtractI3D, mirroring reference
+Composes the flow models into ExtractI3D, mirroring reference
 models/i3d/extract_i3d.py:140-169:
 
   - flow is computed between consecutive frames of the resized, *uncropped*
@@ -19,10 +19,8 @@ I3D forward where XLA fuses it into the first conv. ``ToUInt8`` is
 ``round(128 + 255/40 * x)`` on *floats* — values can reach 256.0 at the +20
 clamp boundary and torch's round is half-to-even, matching ``jnp.round`` —
 so the intermediate stays float32 rather than an actual uint8 cast
-(reference models/transforms.py:168-176).
-
-The PWC flow path (extract_i3d.py:154-155, no padder) plugs in here once the
-PWC family lands.
+(reference models/transforms.py:168-176). The PWC path (extract_i3d.py:
+154-155) skips the padder: PWCNet handles sizing internally.
 """
 from __future__ import annotations
 
@@ -37,16 +35,29 @@ from ..parallel.mesh import DataParallelApply
 from ..weights import store
 
 
-def _raft_quantized_flow(model: raft_model.RAFT, crop: int, params,
-                         pairs_u8):
-    """(B, 2, H, W, 3) uint8 -> (B, crop, crop, 2) quantized flow floats."""
-    flow, _ = raft_model.padded_flow(model, params,
-                                     pairs_u8.astype(jnp.float32))
+def _crop_quantize(flow: jnp.ndarray, crop: int) -> jnp.ndarray:
+    """TensorCenterCrop -> Clamp(-20,20) -> ToUInt8 (extract_i3d.py:53-59)."""
     hp, wp = flow.shape[1], flow.shape[2]
     i, j = (hp - crop) // 2, (wp - crop) // 2  # TensorCenterCrop floor rule
     flow = flow[:, i:i + crop, j:j + crop, :]
     flow = jnp.clip(flow, -20.0, 20.0)
     return jnp.round(128.0 + 255.0 / 40.0 * flow)
+
+
+def _raft_quantized_flow(model: raft_model.RAFT, crop: int, params,
+                         pairs_u8):
+    """(B, 2, H, W, 3) uint8 -> (B, crop, crop, 2) quantized flow floats."""
+    flow, _ = raft_model.padded_flow(model, params,
+                                     pairs_u8.astype(jnp.float32))
+    return _crop_quantize(flow, crop)
+
+
+def _pwc_quantized_flow(model, crop: int, params, pairs_u8):
+    """PWC twin of :func:`_raft_quantized_flow` — input-resolution flow, no
+    padding (the crop happens on the unpadded field)."""
+    x = pairs_u8.astype(jnp.float32)
+    flow = model.apply({"params": params}, x[:, 0], x[:, 1])
+    return _crop_quantize(flow, crop)
 
 
 class FlowStream:
@@ -67,8 +78,18 @@ class FlowStream:
                 partial(_raft_quantized_flow, flow_model, crop), flow_params,
                 mesh=mesh, fixed_batch=parent.stack_size)
         elif parent.flow_type == "pwc":
-            raise NotImplementedError(
-                "flow_type=pwc arrives with the PWC family")
+            # PWC path: no padder — the net resizes to /64 internally and
+            # returns input-resolution flow (extract_i3d.py:154-155)
+            from ..models import pwc as pwc_model
+            flow_model = pwc_model.PWCNet()
+            flow_params = store.resolve_params(
+                "pwc_sintel", pwc_model.init_params,
+                pwc_model.params_from_torch,
+                weights_path=args.get("flow_model_weights_path"),
+                allow_random=allow_random)
+            self.pair_runner = DataParallelApply(
+                partial(_pwc_quantized_flow, flow_model, crop), flow_params,
+                mesh=mesh, fixed_batch=parent.stack_size)
         else:
             raise NotImplementedError(
                 f"flow_type={parent.flow_type!r}; reference supports "

@@ -1,0 +1,42 @@
+"""PWC-Net flow extractor.
+
+Parity target: reference models/pwc/extract_pwc.py (+ base_flow_extractor):
+single sintel checkpoint, optional edge resize; no InputPadder — PWCNet
+resizes to /64 multiples internally and rescales the flow back
+(pwc_net.py:267-296). The reference's GPU-only restriction
+(utils/utils.py:104-105) came from the CuPy CUDA correlation kernel; the
+XLA cost volume in models/pwc.py has no such constraint.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..models import pwc as pwc_model
+from ..parallel.mesh import DataParallelApply, get_mesh
+from ..weights import store
+from .flow import OpticalFlowExtractor
+
+
+def _pwc_forward(model: pwc_model.PWCNet, params, pairs_u8):
+    """(B, 2, H, W, 3) uint8 -> (B, H, W, 2) flow."""
+    x = pairs_u8.astype(jnp.float32)
+    return model.apply({"params": params}, x[:, 0], x[:, 1]).astype(
+        jnp.float32)
+
+
+class ExtractPWC(OpticalFlowExtractor):
+
+    def __init__(self, args: Config) -> None:
+        super().__init__(args)
+        self.model = pwc_model.PWCNet()
+        params = store.resolve_params(
+            "pwc_sintel", pwc_model.init_params, pwc_model.params_from_torch,
+            weights_path=args.get("weights_path"),
+            allow_random=bool(args.get("allow_random_weights", False)))
+        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.runner = DataParallelApply(
+            partial(_pwc_forward, self.model), params, mesh=mesh,
+            fixed_batch=self.batch_size)

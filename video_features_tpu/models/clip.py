@@ -1,0 +1,446 @@
+"""OpenAI CLIP (both visual backbones + text tower) as Flax modules, NHWC.
+
+Parity target: reference models/clip/clip_src/model.py — the image encoder
+(``VisionTransformer`` :206-240 or ``ModifiedResNet`` :96-154), the text
+transformer with causal mask (:195-203, :328-334), ``QuickGELU``
+``x * sigmoid(1.702 x)`` (:166-168), fp32 LayerNorms inside an fp16 model
+(:157-163), and the attention-pooled ResNet head ``AttentionPool2d``
+(:58-93, query = the mean token).
+
+Design notes (TPU):
+  - parameters are kept float32 (the OpenAI checkpoints ship fp16 tensors
+    for conv/linear — model.py:375-396; the converter upcasts). Compute can
+    run bfloat16 via the extractor's ``precision`` knob; LayerNorms always
+    compute in float32, mirroring the reference's fp16-safe LayerNorm.
+  - attention is implemented with packed-per-head einsums that XLA maps onto
+    the MXU; the (77, 77) causal mask is an additive constant folded into
+    the compiled program.
+  - per-frame vision attention is over 50-577 patch tokens — "sequence
+    scale" in this workload is the *frame batch*, sharded over the mesh's
+    data axis (SURVEY §5 "long-context" note).
+
+Config inference from checkpoint shapes replicates ``build_model``
+(model.py:399-436), so any OpenAI / fine-tuned state_dict picks its own
+architecture, exactly like the reference's ``custom`` path.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .common import BNInf
+from ..weights import torch_import as ti
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    embed_dim: int
+    image_resolution: int
+    vision_layers: Union[Tuple[int, int, int, int], int]
+    vision_width: int
+    vision_patch_size: Optional[int]
+    context_length: int
+    vocab_size: int
+    transformer_width: int
+    transformer_heads: int
+    transformer_layers: int
+
+    @property
+    def is_vit(self) -> bool:
+        return not isinstance(self.vision_layers, (tuple, list))
+
+
+def _cfg(embed_dim, image_resolution, vision_layers, vision_width,
+         vision_patch_size, transformer_width, transformer_layers=12):
+    return CLIPConfig(
+        embed_dim=embed_dim, image_resolution=image_resolution,
+        vision_layers=vision_layers, vision_width=vision_width,
+        vision_patch_size=vision_patch_size, context_length=77,
+        vocab_size=49408, transformer_width=transformer_width,
+        transformer_heads=transformer_width // 64,
+        transformer_layers=transformer_layers)
+
+
+# the model zoo the reference downloads from the OpenAI CDN (clip.py:32-42);
+# shapes match build_model's inference on those checkpoints
+CONFIGS: Dict[str, CLIPConfig] = {
+    "RN50": _cfg(1024, 224, (3, 4, 6, 3), 64, None, 512),
+    "RN101": _cfg(512, 224, (3, 4, 23, 3), 64, None, 512),
+    "RN50x4": _cfg(640, 288, (4, 6, 10, 6), 80, None, 640),
+    "RN50x16": _cfg(768, 384, (6, 8, 18, 8), 96, None, 768),
+    "RN50x64": _cfg(1024, 448, (3, 15, 36, 10), 128, None, 1024),
+    "ViT-B/32": _cfg(512, 224, 12, 768, 32, 512),
+    "ViT-B/16": _cfg(512, 224, 12, 768, 16, 512),
+    "ViT-L/14": _cfg(768, 224, 24, 1024, 14, 768),
+    "ViT-L/14@336px": _cfg(768, 336, 24, 1024, 14, 768),
+}
+
+
+def available_models() -> List[str]:
+    return list(CONFIGS)
+
+
+class LNf32(nn.Module):
+    """LayerNorm computed in float32 regardless of activation dtype
+    (model.py:157-163)."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln")(
+            x.astype(jnp.float32))
+        return y.astype(x.dtype)
+
+
+class MHA(nn.Module):
+    """torch ``nn.MultiheadAttention`` semantics with separate q/k/v trees
+    (the converter splits torch's packed ``in_proj``); also serves
+    ``AttentionPool2d`` via ``out_name='c_proj'`` + a 1-token query."""
+    embed_dim: int
+    num_heads: int
+    out_dim: Optional[int] = None
+    out_name: str = "out_proj"
+
+    @nn.compact
+    def __call__(self, q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        e, h = self.embed_dim, self.num_heads
+        hd = e // h
+
+        def heads(x):
+            return x.reshape(x.shape[0], x.shape[1], h, hd)
+
+        qh = heads(nn.Dense(e, name="q_proj")(q)) * (hd ** -0.5)
+        kh = heads(nn.Dense(e, name="k_proj")(k))
+        vh = heads(nn.Dense(e, name="v_proj")(v))
+        att = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
+        if mask is not None:
+            att = att + mask
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, vh)
+        out = out.reshape(q.shape[0], q.shape[1], e)
+        return nn.Dense(self.out_dim or e, name=self.out_name)(out)
+
+
+class ResidualAttentionBlock(nn.Module):
+    """model.py:171-193."""
+    d_model: int
+    n_head: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        y = LNf32(name="ln_1")(x)
+        x = x + MHA(self.d_model, self.n_head, name="attn")(y, y, y, mask)
+        y = LNf32(name="ln_2")(x)
+        hterm = nn.Dense(self.d_model * 4, name="mlp_c_fc")(y)
+        hterm = hterm * nn.sigmoid(1.702 * hterm)  # QuickGELU
+        return x + nn.Dense(self.d_model, name="mlp_c_proj")(hterm)
+
+
+class Transformer(nn.Module):
+    """model.py:195-203; resblocks unrolled (<=24 layers, one HLO each —
+    XLA CSEs the identical block structure at compile time)."""
+    width: int
+    layers: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        for i in range(self.layers):
+            x = ResidualAttentionBlock(self.width, self.heads,
+                                       name=f"resblocks_{i}")(x, mask)
+        return x
+
+
+class VisionTransformer(nn.Module):
+    """model.py:206-240. Input (B, R, R, 3) normalized; output (B, embed)."""
+    width: int
+    layers: int
+    patch_size: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        p, w = self.patch_size, self.width
+        x = nn.Conv(w, (p, p), strides=p, use_bias=False, name="conv1")(x)
+        b, gh, gw, _ = x.shape
+        x = x.reshape(b, gh * gw, w)
+        cls = self.param("class_embedding", nn.initializers.normal(), (w,))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (b, 1, w)), x], axis=1)
+        pos = self.param("positional_embedding", nn.initializers.normal(),
+                         (gh * gw + 1, w))
+        x = x + pos.astype(x.dtype)
+        x = LNf32(name="ln_pre")(x)
+        x = Transformer(w, self.layers, w // 64, name="transformer")(x)
+        x = LNf32(name="ln_post")(x[:, 0])
+        proj = self.param("proj", nn.initializers.normal(),
+                          (w, self.output_dim))
+        return x @ proj.astype(x.dtype)
+
+
+class Bottleneck(nn.Module):
+    """Anti-aliased CLIP bottleneck (model.py:10-55): all convs stride 1, an
+    AvgPool2d(stride) after conv2 (and prepended to the downsample conv)."""
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        out_ch = self.planes * 4
+        y = nn.relu(BNInf(name="bn1")(
+            nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)))
+        y = nn.relu(BNInf(name="bn2")(
+            nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    name="conv2")(y)))
+        if self.stride > 1:
+            y = nn.avg_pool(y, (self.stride,) * 2, (self.stride,) * 2)
+        y = BNInf(name="bn3")(
+            nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(y))
+        if self.stride > 1 or x.shape[-1] != out_ch:
+            x = nn.avg_pool(x, (self.stride,) * 2, (self.stride,) * 2)
+            x = BNInf(name="downsample_1")(
+                nn.Conv(out_ch, (1, 1), use_bias=False,
+                        name="downsample_0")(x))
+        return nn.relu(y + x)
+
+
+class ModifiedResNet(nn.Module):
+    """model.py:96-154: 3-conv stem + avgpool, anti-aliased bottlenecks,
+    attention-pool head."""
+    layers: Tuple[int, int, int, int]
+    width: int
+    output_dim: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        w = self.width
+        for i, (ch, stride) in enumerate([(w // 2, 2), (w // 2, 1), (w, 1)]):
+            x = nn.relu(BNInf(name=f"bn{i + 1}")(
+                nn.Conv(ch, (3, 3), strides=stride, padding=1, use_bias=False,
+                        name=f"conv{i + 1}")(x)))
+        x = nn.avg_pool(x, (2, 2), (2, 2))
+        for stage, (planes, blocks) in enumerate(
+                zip((w, w * 2, w * 4, w * 8), self.layers)):
+            for blk in range(blocks):
+                stride = 2 if (stage > 0 and blk == 0) else 1
+                x = Bottleneck(planes, stride,
+                               name=f"layer{stage + 1}_{blk}")(x)
+
+        # AttentionPool2d (model.py:58-93): tokens = [mean, HW...], query =
+        # the mean token only
+        b, hh, ww, c = x.shape
+        tokens = x.reshape(b, hh * ww, c)
+        tokens = jnp.concatenate(
+            [jnp.mean(tokens, axis=1, keepdims=True), tokens], axis=1)
+        pos = self.param("attnpool_positional_embedding",
+                         nn.initializers.normal(), (hh * ww + 1, c))
+        tokens = tokens + pos.astype(tokens.dtype)
+        pooled = MHA(c, self.heads, out_dim=self.output_dim,
+                     out_name="c_proj", name="attnpool")(
+            tokens[:, :1], tokens, tokens)
+        return pooled[:, 0]
+
+
+class CLIP(nn.Module):
+    """Image/text encoders (model.py:243-371). Images must already be
+    resized/cropped/normalized; text is (B, context_length) int32 from
+    utils/tokenizer.py."""
+    cfg: CLIPConfig
+
+    def setup(self):
+        c = self.cfg
+        if c.is_vit:
+            self.visual = VisionTransformer(
+                width=c.vision_width, layers=c.vision_layers,
+                patch_size=c.vision_patch_size, output_dim=c.embed_dim,
+                name="visual")
+        else:
+            self.visual = ModifiedResNet(
+                layers=tuple(c.vision_layers), width=c.vision_width,
+                output_dim=c.embed_dim, heads=c.vision_width * 32 // 64,
+                name="visual")
+        self.transformer = Transformer(c.transformer_width,
+                                       c.transformer_layers,
+                                       c.transformer_heads,
+                                       name="transformer")
+        self.token_embedding = self.param(
+            "token_embedding", nn.initializers.normal(0.02),
+            (c.vocab_size, c.transformer_width))
+        self.positional_embedding = self.param(
+            "positional_embedding", nn.initializers.normal(0.01),
+            (c.context_length, c.transformer_width))
+        self.ln_final = LNf32(name="ln_final")
+        self.text_projection = self.param(
+            "text_projection", nn.initializers.normal(),
+            (c.transformer_width, c.embed_dim))
+        self.logit_scale = self.param(
+            "logit_scale", nn.initializers.constant(np.log(1 / 0.07)), ())
+
+    def encode_image(self, image: jnp.ndarray) -> jnp.ndarray:
+        return self.visual(image)
+
+    def encode_text(self, text: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.take(self.token_embedding, text, axis=0)
+        x = x + self.positional_embedding
+        # additive causal mask: -inf strictly above the diagonal
+        # (model.py:328-334); fp32 softmax keeps -inf rows exact
+        n = self.cfg.context_length
+        mask = jnp.triu(jnp.full((n, n), -jnp.inf, dtype=jnp.float32), k=1)
+        x = self.transformer(x, mask)
+        x = self.ln_final(x)
+        # features from the eot embedding = the highest token id per row
+        # (model.py:354-356)
+        eot = jnp.argmax(text, axis=-1)
+        x = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        return x @ self.text_projection
+
+    def __call__(self, image: jnp.ndarray,
+                 text: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        img = self.encode_image(image)
+        txt = self.encode_text(text)
+        img = img / jnp.linalg.norm(img, axis=1, keepdims=True)
+        txt = txt / jnp.linalg.norm(txt, axis=1, keepdims=True)
+        logits_per_image = jnp.exp(self.logit_scale) * img @ txt.T
+        return logits_per_image, logits_per_image.T
+
+
+# ---- config inference + weight transplant --------------------------------
+
+def config_from_state_dict(sd: Mapping[str, Any]) -> CLIPConfig:
+    """Infer the architecture from checkpoint shapes (build_model,
+    model.py:399-436)."""
+    if "visual.proj" in sd:
+        vision_width = sd["visual.conv1.weight"].shape[0]
+        vision_layers = len([k for k in sd
+                             if k.startswith("visual.")
+                             and k.endswith(".attn.in_proj_weight")])
+        vision_patch_size = sd["visual.conv1.weight"].shape[-1]
+        grid = round((sd["visual.positional_embedding"].shape[0] - 1) ** 0.5)
+        image_resolution = vision_patch_size * grid
+    else:
+        vision_layers = tuple(
+            len({k.split(".")[2] for k in sd
+                 if k.startswith(f"visual.layer{b}")}) for b in (1, 2, 3, 4))
+        vision_width = sd["visual.layer1.0.conv1.weight"].shape[0]
+        out_width = round(
+            (sd["visual.attnpool.positional_embedding"].shape[0] - 1) ** 0.5)
+        vision_patch_size = None
+        image_resolution = out_width * 32
+    transformer_width = sd["ln_final.weight"].shape[0]
+    return CLIPConfig(
+        embed_dim=sd["text_projection"].shape[1],
+        image_resolution=image_resolution,
+        vision_layers=vision_layers,
+        vision_width=vision_width,
+        vision_patch_size=vision_patch_size,
+        context_length=sd["positional_embedding"].shape[0],
+        vocab_size=sd["token_embedding.weight"].shape[0],
+        transformer_width=transformer_width,
+        transformer_heads=transformer_width // 64,
+        transformer_layers=len({k.split(".")[2] for k in sd
+                                if k.startswith("transformer.resblocks")}))
+
+
+def _f32(t) -> np.ndarray:
+    """Checkpoint tensors may be fp16 (convert_weights, model.py:375-396)."""
+    return ti.to_np(t).astype(np.float32)
+
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """OpenAI CLIP state_dict (ViT or ModifiedResNet) -> Flax tree."""
+    sd = ti.strip_module_prefix(state_dict)
+    params: Dict[str, Any] = {}
+    for key, t in sd.items():
+        if key in ("input_resolution", "context_length", "vocab_size"):
+            continue  # non-tensor metadata build_model deletes (model.py:430)
+        if key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        leaf = parts[-1]
+        mods = parts[:-1]
+
+        # raw (module-less) parameters
+        if leaf in ("class_embedding", "positional_embedding", "proj",
+                    "text_projection", "logit_scale"):
+            if mods and mods[-1] == "attnpool":
+                # attnpool pos-emb lives beside the pool in our tree
+                path = "/".join(mods[:-1] + ["attnpool_positional_embedding"])
+            else:
+                path = "/".join(mods + [leaf])
+            ti.set_in(params, path, _f32(t))
+            continue
+        if len(mods) >= 1 and mods[-1] == "token_embedding":
+            ti.set_in(params, "token_embedding", _f32(t))
+            continue
+
+        # packed qkv -> split into q/k/v trees
+        if leaf in ("in_proj_weight", "in_proj_bias"):
+            qkv = np.split(_f32(t), 3, axis=0)
+            flat = _flatten_mods(mods)
+            for name, part in zip(("q_proj", "k_proj", "v_proj"), qkv):
+                if leaf == "in_proj_weight":
+                    ti.set_in(params, "/".join(flat + [name, "kernel"]),
+                              np.transpose(part))
+                else:
+                    ti.set_in(params, "/".join(flat + [name, "bias"]), part)
+            continue
+
+        flat = _flatten_mods(mods)
+        base = ".".join(mods)
+        if f"{base}.running_mean" in sd:  # BatchNorm
+            bnl = {"weight": "scale", "bias": "bias", "running_mean": "mean",
+                   "running_var": "var"}[leaf]
+            ti.set_in(params, "/".join(flat + [bnl]), _f32(t))
+        elif leaf == "weight" and flat[-1].startswith("ln"):
+            ti.set_in(params, "/".join(flat + ["ln", "scale"]), _f32(t))
+        elif leaf == "bias" and flat[-1].startswith("ln"):
+            ti.set_in(params, "/".join(flat + ["ln", "bias"]), _f32(t))
+        elif leaf == "weight" and t.dim() == 4:
+            ti.set_in(params, "/".join(flat + ["kernel"]),
+                      np.transpose(_f32(t), (2, 3, 1, 0)))
+        elif leaf == "weight":
+            ti.set_in(params, "/".join(flat + ["kernel"]),
+                      np.transpose(_f32(t)))
+        elif leaf == "bias":
+            ti.set_in(params, "/".join(flat + ["bias"]), _f32(t))
+        else:
+            raise ValueError(f"unexpected CLIP key {key}")
+    return params
+
+
+def _flatten_mods(mods: Sequence[str]) -> List[str]:
+    """torch dotted path -> our module names: merge Sequential indices
+    (resblocks.0 -> resblocks_0, layer1.0 -> layer1_0, downsample.0 ->
+    downsample_0) and the mlp Sequential's children (mlp.c_fc -> mlp_c_fc)."""
+    flat: List[str] = []
+    skip = False
+    for i, m in enumerate(mods):
+        if skip:
+            skip = False
+            continue
+        if m == "mlp" and i + 1 < len(mods):
+            flat.append(f"mlp_{mods[i + 1]}")
+            skip = True
+        elif (m.isdigit() or m == "-1") and flat:
+            flat[-1] = f"{flat[-1]}_{m}"
+        else:
+            flat.append(m)
+    return flat
+
+
+def init_params(model_name: str = "ViT-B/32") -> Dict[str, Any]:
+    cfg = CONFIGS[model_name]
+    model = CLIP(cfg)
+    r = cfg.image_resolution
+    v = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, r, r, 3), jnp.float32),
+                   jnp.zeros((1, cfg.context_length), jnp.int32))
+    return v["params"]

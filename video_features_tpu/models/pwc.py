@@ -1,0 +1,260 @@
+"""PWC-Net optical flow as a JAX/Flax program, NHWC, static shapes.
+
+Parity target: reference models/pwc/pwc_src/pwc_net.py (the sniklaus
+pytorch-pwc port, sintel checkpoint) as it behaves in its pinned
+environment (PyTorch 1.2 + CUDA 10 + CuPy — the reference needs a separate
+conda env just for this model, SURVEY §1 "dual-environment split"):
+
+  - 6-level conv ``Extractor`` pyramid, LeakyReLU(0.1) everywhere
+    (pwc_net.py:53-119),
+  - coarse-to-fine ``Decoder`` per level (pwc_net.py:125-211): upsample
+    flow/feat with ConvTranspose(4, stride 2, pad 1); warp the second
+    pyramid level by ``flow * dblBackward`` (``Backward`` grid-sample warp
+    with the all-ones validity-mask trick, pwc_net.py:25-50); 81-channel
+    cost volume; DenseNet-style concat stack,
+  - dilated-conv ``Refiner`` added to the finest (1/4) flow
+    (pwc_net.py:213-235),
+  - input RGB->BGR, /255 (pwc_net.py:255-257), bilinear resize to /64
+    multiples (align_corners=False, pwc_net.py:267-275), output upsampled
+    back, x20, per-axis rescaled (pwc_net.py:290-296).
+
+The cost volume replaces the reference's runtime-JIT'd CUDA kernel
+(correlation.py:47-115: channel c = (dy+4)*9 + (dx+4), mean over channels,
+4 px zero padding) with 81 static shifted-window products that XLA fuses —
+no native extension, which also kills the reference's dual-env constraint.
+The warp replicates torch-1.2 ``grid_sample`` (align_corners=True, zeros
+padding) — the behavior of the env the checkpoint was published for.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..weights import torch_import as ti
+
+CORR_RADIUS = 4
+
+# per-stage channel widths of the feature pyramid (pwc_net.py:57-113)
+_PYRAMID = (("moduleOne", 16), ("moduleTwo", 32), ("moduleThr", 64),
+            ("moduleFou", 96), ("moduleFiv", 128), ("moduleSix", 196))
+# decoder input width at each level is 81 cost channels, plus features +
+# 2 flow + 2 upfeat below level 6 (pwc_net.py:129-132) — inferred from the
+# input shapes by the compact modules, listed here only for orientation
+# magnification applied to the upsampled flow before warping
+# (pwc_net.py:137: dblBackward indexed at intLevel+1)
+_DBL_BACKWARD = {2: 5.0, 3: 2.5, 4: 1.25, 5: 0.625}
+
+
+def leaky(x: jnp.ndarray) -> jnp.ndarray:
+    return nn.leaky_relu(x, negative_slope=0.1)
+
+
+def correlation_volume(f1: jnp.ndarray, f2: jnp.ndarray,
+                       radius: int = CORR_RADIUS) -> jnp.ndarray:
+    """81-channel windowed cost volume (correlation.py:47-115).
+
+    (B, H, W, C) x2 -> (B, H, W, (2r+1)^2); channel (dy+r)*(2r+1)+(dx+r) is
+    the channel-mean of ``f1 * shift(f2, dy, dx)`` with zero padding. Static
+    slices — XLA fuses the 81 multiply-reduce windows without materializing
+    shifted copies.
+    """
+    b, h, w, c = f1.shape
+    f2p = jnp.pad(f2, ((0, 0), (radius, radius), (radius, radius), (0, 0)))
+    out = []
+    for dy in range(-radius, radius + 1):
+        for dx in range(-radius, radius + 1):
+            win = f2p[:, radius + dy:radius + dy + h,
+                      radius + dx:radius + dx + w, :]
+            out.append(jnp.mean(f1 * win, axis=-1))
+    return jnp.stack(out, axis=-1)
+
+
+def bilinear_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
+    """``Backward`` (pwc_net.py:25-50): sample ``feat`` at ``grid + flow``
+    with torch-1.2 grid_sample semantics (align_corners=True, zeros
+    padding), then zero out samples whose all-ones-channel came back < 1
+    after the same interpolation (the partial-visibility mask)."""
+    b, h, w, c = feat.shape
+    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=flow.dtype),
+                          jnp.arange(h, dtype=flow.dtype))
+    x = gx[None] + flow[..., 0]
+    y = gy[None] + flow[..., 1]
+    x0, y0 = jnp.floor(x), jnp.floor(y)
+
+    sampled = jnp.zeros(feat.shape, feat.dtype)
+    ones = jnp.zeros((b, h, w), feat.dtype)
+    for xi, wx in ((x0, 1.0 - (x - x0)), (x0 + 1, x - x0)):
+        for yi, wy in ((y0, 1.0 - (y - y0)), (y0 + 1, y - y0)):
+            valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            corner = feat[jnp.arange(b)[:, None, None], yc, xc]
+            weight = jnp.where(valid, wx * wy, 0.0)
+            sampled = sampled + weight[..., None] * corner
+            ones = ones + weight
+    # mask rule (pwc_net.py:47-49): >0.999 -> 1, anything below -> 0
+    mask = (ones > 0.999).astype(feat.dtype)
+    return sampled * mask[..., None]
+
+
+def conv_transpose_4s2p1(x: jnp.ndarray, kernel: jnp.ndarray,
+                         bias: jnp.ndarray) -> jnp.ndarray:
+    """torch ConvTranspose2d(k=4, stride=2, pad=1): input-dilated conv with
+    the spatially-flipped kernel and (k-1-p)=2 padding; output = 2x input.
+
+    ``kernel`` is pre-converted to HWIO by the weight importer."""
+    return jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(1, 1), padding=((2, 2), (2, 2)),
+        lhs_dilation=(2, 2),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+
+
+class Extractor(nn.Module):
+    """pwc_net.py:53-119: 6 stages of [stride-2 conv, conv, conv]."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> List[jnp.ndarray]:
+        feats = []
+        for stage, ch in _PYRAMID:
+            for idx in (0, 2, 4):
+                x = leaky(nn.Conv(ch, (3, 3), strides=2 if idx == 0 else 1,
+                                  padding=1, name=f"{stage}_{idx}")(x))
+            feats.append(x)
+        return feats
+
+
+class Decoder(nn.Module):
+    """pwc_net.py:125-211: cost volume + DenseNet concat stack. Returns
+    (flow, feat)."""
+    level: int
+
+    @nn.compact
+    def __call__(self, first: jnp.ndarray, second: jnp.ndarray,
+                 prev: Optional[Tuple[jnp.ndarray, jnp.ndarray]]):
+        if prev is None:
+            feat = leaky(correlation_volume(first, second))
+        else:
+            prev_flow, prev_feat = prev
+            up_k = self.param("moduleUpflow_kernel", nn.initializers.normal(),
+                              (4, 4, 2, 2))
+            up_b = self.param("moduleUpflow_bias", nn.initializers.zeros, (2,))
+            flow = conv_transpose_4s2p1(prev_flow, up_k, up_b)
+            uf_in = prev_feat.shape[-1]
+            uf_k = self.param("moduleUpfeat_kernel", nn.initializers.normal(),
+                              (4, 4, uf_in, 2))
+            uf_b = self.param("moduleUpfeat_bias", nn.initializers.zeros, (2,))
+            upfeat = conv_transpose_4s2p1(prev_feat, uf_k, uf_b)
+            warped = bilinear_warp(second, flow * _DBL_BACKWARD[self.level])
+            volume = leaky(correlation_volume(first, warped))
+            feat = jnp.concatenate([volume, first, flow, upfeat], axis=-1)
+
+        for name, ch in (("moduleOne", 128), ("moduleTwo", 128),
+                         ("moduleThr", 96), ("moduleFou", 64),
+                         ("moduleFiv", 32)):
+            y = leaky(nn.Conv(ch, (3, 3), padding=1, name=f"{name}_0")(feat))
+            feat = jnp.concatenate([y, feat], axis=-1)  # new features FIRST
+        flow = nn.Conv(2, (3, 3), padding=1, name="moduleSix_0")(feat)
+        return flow, feat
+
+
+class Refiner(nn.Module):
+    """pwc_net.py:213-235: dilated context network."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        specs = ((128, 1, 0), (128, 2, 2), (128, 4, 4), (96, 8, 6),
+                 (64, 16, 8), (32, 1, 10), (2, 1, 12))
+        for ch, dil, idx in specs:
+            y = nn.Conv(ch, (3, 3), padding=dil, kernel_dilation=dil,
+                        name=f"moduleMain_{idx}")(x)
+            x = leaky(y) if idx < 12 else y
+        return x
+
+
+def _resize_bilinear(x: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """torch F.interpolate(mode='bilinear', align_corners=False) equivalent
+    (half-pixel centers, no antialias)."""
+    return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), "bilinear",
+                            antialias=False)
+
+
+class PWCNet(nn.Module):
+    """(B, H, W, 3) RGB [0,255] pairs -> (B, H, W, 2) flow in pixels
+    (pwc_net.py:238-296)."""
+
+    @nn.compact
+    def __call__(self, image1: jnp.ndarray,
+                 image2: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, _ = image1.shape
+        # RGB -> BGR, /255 (pwc_net.py:255-257)
+        image1 = image1[..., ::-1] / 255.0
+        image2 = image2[..., ::-1] / 255.0
+        hp = -(-h // 64) * 64
+        wp = -(-w // 64) * 64
+        if (hp, wp) != (h, w):
+            image1 = _resize_bilinear(image1, hp, wp)
+            image2 = _resize_bilinear(image2, hp, wp)
+
+        extractor = Extractor(name="moduleExtractor")
+        firsts = extractor(image1)
+        seconds = extractor(image2)
+
+        prev = None
+        # coarse-to-fine: level 6 (1/64) down to 2 (1/4) (pwc_net.py:277-287)
+        for level, name in ((6, "moduleSix"), (5, "moduleFiv"),
+                            (4, "moduleFou"), (3, "moduleThr"),
+                            (2, "moduleTwo")):
+            idx = level - 1  # pyramid list is fine-to-coarse
+            flow, feat = Decoder(level, name=name)(
+                firsts[idx], seconds[idx], prev)
+            prev = (flow, feat)
+
+        flow = prev[0] + Refiner(name="moduleRefiner")(prev[1])
+        flow = 20.0 * _resize_bilinear(flow, h, w)
+        scale = jnp.array([w / wp, h / hp], dtype=flow.dtype)
+        return flow * scale
+
+
+# ---- weight transplant ---------------------------------------------------
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """pwc_net_sintel.pt state_dict -> Flax tree.
+
+    Keys: ``module{Extractor,Two..Six,Refiner}.module*.N.{weight,bias}``.
+    ConvTranspose weights (IOHW) become input-dilated-conv kernels: flip
+    spatial dims, transpose to HWIO.
+    """
+    sd = ti.strip_module_prefix(state_dict)
+    params: Dict[str, Any] = {}
+    for key, t in sd.items():
+        parts = key.split(".")
+        leaf = parts.pop()
+        flat: List[str] = []
+        for m in parts:
+            if m.isdigit() and flat:
+                flat[-1] = f"{flat[-1]}_{m}"
+            else:
+                flat.append(m)
+        if flat[-1] in ("moduleUpflow", "moduleUpfeat"):
+            # stored as raw params, not submodules (Decoder.__call__)
+            arr = ti.to_np(t)
+            if leaf == "weight":
+                arr = np.transpose(arr[:, :, ::-1, ::-1], (2, 3, 0, 1))
+            ti.set_in(params, "/".join(flat[:-1] + [f"{flat[-1]}_{'kernel' if leaf == 'weight' else 'bias'}"]), arr)
+        elif leaf == "weight":
+            ti.set_in(params, "/".join(flat + ["kernel"]),
+                      ti.conv2d_kernel(t))
+        else:
+            ti.set_in(params, "/".join(flat + ["bias"]), ti.to_np(t))
+    return params
+
+
+def init_params() -> Dict[str, Any]:
+    model = PWCNet()
+    v = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 64, 64, 3)), jnp.zeros((1, 64, 64, 3)))
+    return v["params"]

@@ -1,0 +1,102 @@
+"""VGGish (AudioSet audio embeddings) as a Flax module, NHWC.
+
+Parity target: reference models/vggish/vggish_src/vggish_slim.py — the
+harritaylor/torchvggish port of the TF-Slim original:
+
+  - conv stack ``[64, M, 128, M, 256, 256, M, 512, 512, M]`` on 1-channel
+    (96, 64) log-mel patches, all 3x3 pad-1 convs + ReLU, 2x2 max pools
+    (vggish_slim.py:102-112),
+  - the flatten before the MLP goes through an NHWC transpose for
+    TF-compat (vggish_slim.py:27-37) — in NHWC layout here, a plain
+    ``reshape`` is already that order,
+  - embeddings MLP 12288 -> 4096 -> 4096 -> 128, ReLU after every layer
+    (vggish_slim.py:19-25),
+  - optional ``Postprocessor``: PCA-whitening + clip to [-2, 2] + 8-bit
+    quantization to [0, 255] (vggish_slim.py:40-99). ``post_process``
+    defaults to False (identity) exactly like the reference's
+    ``forward`` (vggish_slim.py:95-99), so raw embeddings are the output
+    contract.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..weights import torch_import as ti
+
+EMBEDDING_SIZE = 128
+# torch Sequential indices of the parameterized layers (vggish_slim.py:102-112)
+_CONV_IDX = (0, 3, 6, 8, 11, 13)
+_CONV_CH = (64, 128, 256, 256, 512, 512)
+_POOL_AFTER = (0, 3, 8, 13)  # pool follows the conv at these indices
+_FC_IDX = (0, 2, 4)
+_FC_DIM = (4096, 4096, 128)
+
+
+class VGGish(nn.Module):
+    """(B, 96, 64, 1) float log-mel examples -> (B, 128) embeddings."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for idx, ch in zip(_CONV_IDX, _CONV_CH):
+            x = nn.relu(nn.Conv(ch, (3, 3), padding=1,
+                                name=f"features_{idx}")(x))
+            if idx in _POOL_AFTER:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)  # NHWC flatten == vggish_slim.py:30-34
+        for idx, dim in zip(_FC_IDX, _FC_DIM):
+            x = nn.relu(nn.Dense(dim, name=f"embeddings_{idx}")(x))
+        return x
+
+
+def postprocess(embeddings: np.ndarray, pca_eigen_vectors: np.ndarray,
+                pca_means: np.ndarray) -> np.ndarray:
+    """PCA-whiten + quantize to [0, 255] (Postprocessor.postprocess,
+    vggish_slim.py:63-92). numpy: runs once per video on 128-d vectors."""
+    pca = (pca_eigen_vectors @ (embeddings.T - pca_means)).T
+    clipped = np.clip(pca, -2.0, 2.0)
+    return np.squeeze(np.round((clipped + 2.0) * (255.0 / 4.0)))
+
+
+def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """vggish-10086976.pth state_dict -> Flax tree (keys ``features.N.*``,
+    ``embeddings.N.*``)."""
+    sd = ti.strip_module_prefix(state_dict)
+    params: Dict[str, Any] = {}
+    for key, t in sd.items():
+        mod, idx, leaf = key.split(".")
+        name = f"{mod}_{idx}"
+        if leaf == "weight":
+            kernel = (ti.conv2d_kernel(t) if t.dim() == 4
+                      else ti.linear_kernel(t))
+            ti.set_in(params, f"{name}/kernel", kernel)
+        elif leaf == "bias":
+            ti.set_in(params, f"{name}/bias", ti.to_np(t))
+        else:
+            raise ValueError(f"unexpected VGGish key {key}")
+    return params
+
+
+def load_pca_params(path: str):
+    """(pca_eigen_vectors (128, 128), pca_means (128, 1)) from either the
+    torchvggish release ``.pth`` (dict of arrays) or an ``.npz`` twin
+    (reference models/vggish/checkpoints/vggish_pca_params.npz,
+    vggish_postprocess.py:22-91)."""
+    if path.endswith(".npz"):
+        blob = np.load(path)
+    else:
+        import torch
+        blob = torch.load(path, map_location="cpu", weights_only=False)
+    vectors = np.asarray(blob["pca_eigen_vectors"], dtype=np.float32)
+    means = np.asarray(blob["pca_means"], dtype=np.float32).reshape(-1, 1)
+    return vectors, means
+
+
+def init_params() -> Dict[str, Any]:
+    model = VGGish()
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 96, 64, 1)))
+    return v["params"]

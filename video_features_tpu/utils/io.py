@@ -256,3 +256,44 @@ def read_video_frames(path: Union[str, Path],
     if not frames:
         return np.zeros((0, src.height, src.width, 3), dtype=np.uint8), src.fps
     return np.stack(frames), src.fps
+
+
+def which_ffmpeg() -> str:
+    """Path to the ffmpeg binary, or '' (reference utils/utils.py:170-183)."""
+    import shutil
+    return shutil.which("ffmpeg") or ""
+
+
+def extract_wav_from_mp4(video_path: Union[str, Path],
+                         tmp_path: Union[str, Path]) -> Tuple[str, str]:
+    """mp4 -> .aac (codec copy) -> .wav via two ffmpeg calls, written into
+    ``tmp_path`` (reference utils/utils.py:186-215: mp4 cannot be converted
+    to wav directly with ``-acodec copy``, hence the two-step).
+
+    Video decode in this framework is ffmpeg-free (cv2), but there is no
+    in-process AAC decoder available, so the audio rip keeps the reference's
+    ffmpeg dependency and fails with a clear message when the binary is
+    absent.
+    """
+    import subprocess
+
+    ffmpeg = which_ffmpeg()
+    if not ffmpeg:
+        raise RuntimeError(
+            "ffmpeg is required to rip audio from .mp4 (reference "
+            "utils/utils.py:197); install it or pass a .wav file directly")
+    video_path = str(video_path)
+    if not video_path.endswith(".mp4"):
+        raise ValueError(f"expected an .mp4 file, got {video_path}")
+    tmp = Path(tmp_path)
+    tmp.mkdir(parents=True, exist_ok=True)
+    stem = Path(video_path).stem
+    aac = str(tmp / f"{stem}.aac")
+    wav = str(tmp / f"{stem}.wav")
+    for cmd in (
+        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i",
+         video_path, "-acodec", "copy", aac],
+        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i", aac, wav],
+    ):
+        subprocess.run(cmd, check=True)
+    return wav, aac

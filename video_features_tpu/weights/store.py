@@ -39,6 +39,20 @@ HUB_FILENAMES: Dict[str, tuple] = {
     "i3d_flow": ("i3d_flow.pt",),
     "s3d_kinetics400": ("S3D_kinetics400_torchified.pt",),
     "pwc_sintel": ("pwc_net_sintel.pt",),
+    # torchvggish GitHub release filenames (reference vggish_slim.py:122-127)
+    "vggish": ("vggish-10086976.pth",),
+    "vggish_pca": ("vggish_pca_params-970ea276.pth", "vggish_pca_params.npz"),
+    # OpenAI CDN filenames (reference clip_src/clip.py:32-42); TorchScript
+    # archives are unwrapped by torch_import.load_torch_state_dict
+    "clip_RN50": ("RN50.pt",),
+    "clip_RN101": ("RN101.pt",),
+    "clip_RN50x4": ("RN50x4.pt",),
+    "clip_RN50x16": ("RN50x16.pt",),
+    "clip_RN50x64": ("RN50x64.pt",),
+    "clip_ViT-B-32": ("ViT-B-32.pt",),
+    "clip_ViT-B-16": ("ViT-B-16.pt",),
+    "clip_ViT-L-14": ("ViT-L-14.pt",),
+    "clip_ViT-L-14-336px": ("ViT-L-14-336px.pt",),
 }
 
 

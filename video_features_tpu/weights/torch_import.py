@@ -72,10 +72,18 @@ def strip_module_prefix(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
 
 
 def load_torch_state_dict(path: str) -> Dict[str, Any]:
-    """Load a torch checkpoint file to CPU and unwrap common containers."""
+    """Load a torch checkpoint file to CPU and unwrap common containers.
+
+    Handles both plain pickled state_dicts and TorchScript archives — the
+    OpenAI CLIP CDN ships JIT archives, which the reference unwraps the same
+    way (reference models/clip/clip_src/clip.py:128-139: try jit.load, fall
+    back to torch.load)."""
     import torch
 
-    obj = torch.load(path, map_location="cpu", weights_only=False)
+    try:
+        obj = torch.jit.load(path, map_location="cpu").state_dict()
+    except RuntimeError:
+        obj = torch.load(path, map_location="cpu", weights_only=False)
     if isinstance(obj, dict):
         for key in ("state_dict", "model_state_dict", "model"):
             if key in obj and isinstance(obj[key], dict):

@@ -39,15 +39,28 @@ def torch_correlation(tensorFirst, tensorSecond, device=None):
 def _load_reference_pwc():
     if not os.path.exists(REF_PWC):
         pytest.skip("reference PWC source not available")
-    # stub the CuPy correlation module the reference imports at module level
+    # stub the CuPy correlation module the reference imports at module level;
+    # restore sys.modules afterwards so other tests can import the reference
+    # `models` tree as a real namespace package (stub ModuleTypes have no
+    # __path__ and would shadow it)
     corr_mod = types.ModuleType("models.pwc.pwc_src.correlation")
     corr_mod.FunctionCorrelation = torch_correlation
-    for name in ("models", "models.pwc", "models.pwc.pwc_src"):
+    stub_names = ("models", "models.pwc", "models.pwc.pwc_src",
+                  "models.pwc.pwc_src.correlation")
+    saved = {name: sys.modules.get(name) for name in stub_names}
+    for name in stub_names[:-1]:
         sys.modules.setdefault(name, types.ModuleType(name))
     sys.modules["models.pwc.pwc_src.correlation"] = corr_mod
-    spec = importlib.util.spec_from_file_location("ref_pwc", REF_PWC)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    try:
+        spec = importlib.util.spec_from_file_location("ref_pwc", REF_PWC)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        for name in stub_names:
+            if saved[name] is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = saved[name]
     return mod
 
 

@@ -98,17 +98,18 @@ class ExtractCLIP(FrameWiseExtractor):
                 self.pred_texts = list(pred_texts)
             from ..utils.tokenizer import ClipTokenizer
             self._tokens = ClipTokenizer(args.get("bpe_path")).tokenize(
-                self.pred_texts)
+                self.pred_texts, context_length=self.cfg.context_length)
             self._logit_scale = float(np.asarray(params["logit_scale"]))
-            self._encode_text = jax.jit(partial(
-                self.model.apply, {"params": params}, method="encode_text"))
+            self._text_params = params
+            self._encode_text = jax.jit(
+                partial(self.model.apply, method="encode_text"))
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         if not self.show_pred:
             return
         if self._text_feats is None:
-            self._text_feats = np.asarray(
-                self._encode_text(jnp.asarray(self._tokens)))
+            self._text_feats = np.asarray(self._encode_text(
+                {"params": self._text_params}, jnp.asarray(self._tokens)))
         v = feats.astype(np.float64)
         t = self._text_feats.astype(np.float64)
         v = v / np.linalg.norm(v, axis=1, keepdims=True)

@@ -1,0 +1,63 @@
+"""Microbenchmark: pallas vs XLA for the hot kernels, on the real chip.
+
+Run on TPU (no JAX_PLATFORMS override). Used to pick dispatch defaults;
+results recorded in the kernels package docstrings.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.kernels.cost_volume import (cost_volume_pallas,
+                                                    cost_volume_xla)
+from video_features_tpu.kernels.corr_lookup import (corr_lookup_onehot,
+                                                    corr_lookup_pallas)
+from video_features_tpu.models.raft import build_corr_pyramid, corr_lookup
+
+
+def timeit(fn, *args, iters=200):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def main():
+    print("platform:", jax.devices()[0])
+    rng = np.random.default_rng(0)
+
+    print("\n-- PWC cost volume (B,H,W,C) --")
+    for shape in [(1, 112, 256, 32), (1, 56, 128, 64), (4, 28, 64, 96),
+                  (4, 7, 16, 196)]:
+        f1 = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        f2 = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        xla_fn = jax.jit(cost_volume_xla)
+        t_x = timeit(xla_fn, f1, f2)
+        t_p = timeit(lambda a, b: cost_volume_pallas(a, b), f1, f2)
+        print(f"{shape}: xla {t_x:.3f} ms  pallas {t_p:.3f} ms  "
+              f"speedup {t_x / t_p:.2f}x")
+
+    print("\n-- RAFT corr lookup (B, H8, W8) --")
+    for b, h8, w8 in [(1, 46, 46), (4, 46, 46), (8, 28, 28)]:
+        c = 256
+        f1 = jnp.asarray(rng.normal(size=(b, h8, w8, c)).astype(np.float32))
+        f2 = jnp.asarray(rng.normal(size=(b, h8, w8, c)).astype(np.float32))
+        pyramid = jax.block_until_ready(build_corr_pyramid(f1, f2))
+        coords = jnp.asarray(
+            rng.uniform(0, h8, size=(b, h8, w8, 2)).astype(np.float32))
+        gather_fn = jax.jit(corr_lookup)
+        onehot_fn = jax.jit(corr_lookup_onehot)
+        pallas_fn = jax.jit(corr_lookup_pallas)  # one jit: no per-level dispatch
+        t_g = timeit(gather_fn, pyramid, coords)
+        t_o = timeit(onehot_fn, pyramid, coords)
+        t_p = timeit(pallas_fn, pyramid, coords)
+        print(f"B={b} {h8}x{w8}: gather {t_g:.3f} ms  onehot {t_o:.3f} ms  "
+              f"pallas {t_p:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

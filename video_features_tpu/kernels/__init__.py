@@ -1,0 +1,64 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference ships exactly one native compute kernel — the PWC-Net
+correlation (cost volume) written in raw CUDA C and JIT-compiled through CuPy
+(reference models/pwc/pwc_src/correlation.py:47-115) — and does its other
+memory-bound hot loop, the RAFT correlation-pyramid lookup, as a
+grid_sample gather (reference models/raft/raft_src/corr.py:29-50). Here both
+are first-class TPU kernels:
+
+  - :mod:`cost_volume` — the 81-channel windowed cost volume as a Pallas
+    kernel (halo-DMA'd second feature map, channel-major VMEM tiles);
+  - :mod:`corr_lookup` — the windowed bilinear pyramid lookup recast as
+    one-hot matmul contractions (gather-free, rides the MXU), as a fused
+    Pallas kernel and a pure-XLA twin.
+
+Dispatch: the cost-volume wrapper takes ``impl`` = ``'pallas' | 'xla' |
+None``; ``None`` reads the ``VFT_PALLAS`` env var (``1``/``0``), defaulting
+to pallas on TPU backends and XLA elsewhere (pallas interpret mode is used
+automatically on CPU so the kernels stay testable everywhere). The corr
+lookup is selected separately by ``VFT_CORR_LOOKUP`` in models/raft.py —
+``gather`` (default) | ``onehot`` | ``pallas``; both env vars are read at
+trace time, so set them before the first forward of the process.
+
+Measured on TPU v5e (scripts/bench_kernels.py, f32, 200-iteration mean;
+everything here is tens of microseconds, so +-30% run-to-run noise):
+
+  cost volume: pallas 2.2x faster than XLA on the two finest (dominant)
+    pyramid levels — (1,112,256,32): 0.012 vs 0.028 ms; (1,56,128,64):
+    0.011 vs 0.023 ms — the halo-DMA tile reads f2 from HBM once instead
+    of 81 shifted times; coarse levels are launch-bound and come out even.
+  corr lookup (jitted end-to-end): gather / one-hot / fused pallas are all
+    within noise of each other (14-37 us across B=1..8 shapes) — XLA's
+    lane-dim dynamic gather is already near-optimal, so RAFT keeps gather
+    as its default (models/raft.py) and the matmul forms stay alternates.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def pallas_enabled() -> bool:
+    """Static (trace-time) switch for pallas-vs-XLA kernel dispatch."""
+    flag = os.environ.get("VFT_PALLAS", "").strip().lower()
+    if flag in ("1", "true", "yes"):
+        return True
+    if flag in ("0", "false", "no"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas TPU kernels run in interpreter mode off-TPU (tests on CPU)."""
+    return jax.default_backend() != "tpu"
+
+
+from .cost_volume import cost_volume  # noqa: E402
+from .corr_lookup import corr_lookup_onehot, corr_lookup_level_pallas  # noqa: E402
+
+__all__ = [
+    "pallas_enabled", "interpret_mode",
+    "cost_volume", "corr_lookup_onehot", "corr_lookup_level_pallas",
+]

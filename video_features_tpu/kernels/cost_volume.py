@@ -1,0 +1,116 @@
+"""PWC-Net 81-channel cost volume as a Pallas TPU kernel.
+
+Replaces the reference's raw-CUDA correlation kernel (reference
+models/pwc/pwc_src/correlation.py:47-115: output channel ``(dy+4)*9+(dx+4)``
+is the channel-mean of ``f1 * shift(f2, dy, dx)`` with 4 px zero padding).
+
+TPU design (not a translation of the CUDA kernel's shared-memory layout):
+
+  - channel-major tiles: inputs are transposed to (B, C, H, W) so the wide
+    spatial W axis sits on the 128-lane dimension and the reduction over C
+    runs across sublane groups — lane utilization is set by W, not by the
+    (often small: 32..196) channel count;
+  - the second feature map is kept in HBM and each program DMAs exactly its
+    (C, TH+2r, W+2r) halo block into VMEM scratch once, then all 81
+    displacement windows are strided reads of that scratch — f2 moves from
+    HBM once per row-tile instead of 81 times;
+  - the 81 multiply-reduce windows write one (TH, W) channel plane each,
+    contiguous vector stores.
+
+Grid: (B, H/TH). The XLA twin (81 shifted multiply-reduces, fused by XLA) is
+kept for CPU and as a fallback; parity is tested in interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def cost_volume_xla(f1: jnp.ndarray, f2: jnp.ndarray,
+                    radius: int = 4) -> jnp.ndarray:
+    """(B, H, W, C) x2 -> (B, H, W, (2r+1)^2), channel (dy+r)*(2r+1)+(dx+r)."""
+    b, h, w, c = f1.shape
+    f2p = jnp.pad(f2, ((0, 0), (radius, radius), (radius, radius), (0, 0)))
+    out = []
+    for dy in range(-radius, radius + 1):
+        for dx in range(-radius, radius + 1):
+            win = f2p[:, radius + dy:radius + dy + h,
+                      radius + dx:radius + dx + w, :]
+            out.append(jnp.mean(f1 * win, axis=-1))
+    return jnp.stack(out, axis=-1)
+
+
+def _kernel(f1_ref, f2p_ref, out_ref, scratch, sem, *, th: int, radius: int,
+            w: int):
+    bi = pl.program_id(0)
+    ti = pl.program_id(1)
+    d = 2 * radius + 1
+    c = scratch.shape[0]
+    dma = pltpu.make_async_copy(
+        f2p_ref.at[bi, :, pl.ds(ti * th, th + 2 * radius), :], scratch, sem)
+    dma.start()
+    dma.wait()
+    f1v = f1_ref[0].astype(jnp.float32)  # (C, TH, W)
+    inv_c = 1.0 / c
+    for dy in range(d):
+        for dx in range(d):
+            win = scratch[:, dy:dy + th, dx:dx + w].astype(jnp.float32)
+            out_ref[0, dy * d + dx] = jnp.sum(f1v * win, axis=0) * inv_c
+
+
+@functools.partial(jax.jit, static_argnames=("radius", "interpret", "tile_h"))
+def cost_volume_pallas(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
+                       interpret: bool = False,
+                       tile_h: int = 32) -> jnp.ndarray:
+    b, h, w, c = f1.shape
+    d = 2 * radius + 1
+    th = min(tile_h, h)
+    hp = -(-h // th) * th  # rows padded to a tile multiple; cropped after
+    f1t = jnp.moveaxis(f1, -1, 1)  # (B, C, H, W) channel-major
+    f2t = jnp.moveaxis(f2, -1, 1)
+    f1t = jnp.pad(f1t, ((0, 0), (0, 0), (0, hp - h), (0, 0)))
+    # the halo DMA slices f2p along rows only, so its lane (width) dim must
+    # stay whole-and-tile-aligned for Mosaic: pad W+2r up to a 128 multiple
+    w2 = -(-(w + 2 * radius) // 128) * 128
+    f2p = jnp.pad(f2t, ((0, 0), (0, 0),
+                        (radius, radius + hp - h),
+                        (radius, w2 - w - radius)))
+    out = pl.pallas_call(
+        functools.partial(_kernel, th=th, radius=radius, w=w),
+        grid=(b, hp // th),
+        in_specs=[
+            pl.BlockSpec((1, c, th, w), lambda bi, ti: (bi, 0, ti, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # f2p stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, d * d, th, w),
+                               lambda bi, ti: (bi, 0, ti, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, d * d, hp, w), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((c, th + 2 * radius, w2), f2p.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(f1t, f2p)
+    # accumulate in f32, return the input dtype like the XLA twin does
+    return jnp.moveaxis(out[:, :, :h, :], 1, -1).astype(f1.dtype)
+
+
+def cost_volume(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
+                impl: Optional[str] = None) -> jnp.ndarray:
+    """Dispatching wrapper; see package docstring for ``impl`` semantics."""
+    from . import interpret_mode, pallas_enabled
+    if impl is None:
+        impl = "pallas" if pallas_enabled() else "xla"
+    if impl == "pallas":
+        return cost_volume_pallas(f1, f2, radius, interpret=interpret_mode())
+    if impl != "xla":
+        raise ValueError(f"cost_volume impl={impl!r}: expected "
+                         "'pallas' or 'xla'")
+    return cost_volume_xla(f1, f2, radius)

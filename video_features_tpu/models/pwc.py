@@ -58,19 +58,12 @@ def correlation_volume(f1: jnp.ndarray, f2: jnp.ndarray,
     """81-channel windowed cost volume (correlation.py:47-115).
 
     (B, H, W, C) x2 -> (B, H, W, (2r+1)^2); channel (dy+r)*(2r+1)+(dx+r) is
-    the channel-mean of ``f1 * shift(f2, dy, dx)`` with zero padding. Static
-    slices — XLA fuses the 81 multiply-reduce windows without materializing
-    shifted copies.
+    the channel-mean of ``f1 * shift(f2, dy, dx)`` with zero padding.
+    Dispatches to the Pallas halo-DMA kernel on TPU and the XLA
+    shifted-window formulation elsewhere (kernels/cost_volume.py).
     """
-    b, h, w, c = f1.shape
-    f2p = jnp.pad(f2, ((0, 0), (radius, radius), (radius, radius), (0, 0)))
-    out = []
-    for dy in range(-radius, radius + 1):
-        for dx in range(-radius, radius + 1):
-            win = f2p[:, radius + dy:radius + dy + h,
-                      radius + dx:radius + dx + w, :]
-            out.append(jnp.mean(f1 * win, axis=-1))
-    return jnp.stack(out, axis=-1)
+    from ..kernels.cost_volume import cost_volume
+    return cost_volume(f1, f2, radius)
 
 
 def bilinear_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:

@@ -207,6 +207,35 @@ def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
 
 def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
                 radius: int = CORR_RADIUS) -> jnp.ndarray:
+    """Windowed bilinear lookup — implementation dispatcher.
+
+    ``VFT_CORR_LOOKUP`` selects ``gather`` (default), ``onehot`` or
+    ``pallas`` (kernels/corr_lookup.py). The env var is read at TRACE time:
+    it must be set before the first RAFT forward of the process — once the
+    jitted scan body is compiled, changing it has no effect (same caveat as
+    every static jit switch). Measured on TPU v5e (jitted, 46x46 grid,
+    B=1..8): all three are within measurement noise of each other (14-37
+    us) — XLA lowers the 4-corner take_along_axis to lane-dim dynamic
+    gathers which are near-bandwidth-optimal here, so gather stays the
+    default and the matmul formulations remain documented alternates."""
+    import os
+    impl = os.environ.get("VFT_CORR_LOOKUP", "gather").strip().lower()
+    if impl == "onehot":
+        from ..kernels.corr_lookup import corr_lookup_onehot
+        return corr_lookup_onehot(pyramid, coords, radius)
+    if impl == "pallas":
+        from ..kernels import interpret_mode
+        from ..kernels.corr_lookup import corr_lookup_pallas
+        return corr_lookup_pallas(pyramid, coords, radius,
+                                  interpret=interpret_mode())
+    if impl != "gather":
+        raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
+                         "'gather', 'onehot' or 'pallas'")
+    return corr_lookup_gather(pyramid, coords, radius)
+
+
+def corr_lookup_gather(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
+                       radius: int = CORR_RADIUS) -> jnp.ndarray:
     """Windowed bilinear lookup (corr.py:29-50).
 
     coords: (B, H, W, 2) (x, y) at level-0 resolution. Returns

@@ -56,9 +56,9 @@ def interpret_mode() -> bool:
 
 
 from .cost_volume import cost_volume  # noqa: E402
-from .corr_lookup import corr_lookup_onehot, corr_lookup_level_pallas  # noqa: E402
+from .corr_lookup import corr_lookup_onehot, corr_lookup_pallas  # noqa: E402
 
 __all__ = [
     "pallas_enabled", "interpret_mode",
-    "cost_volume", "corr_lookup_onehot", "corr_lookup_level_pallas",
+    "cost_volume", "corr_lookup_onehot", "corr_lookup_pallas",
 ]

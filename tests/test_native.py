@@ -1,0 +1,127 @@
+"""Native IO layer (atomic .npy writer / O(header) validator) + Prefetcher."""
+import pickle
+
+import numpy as np
+import pytest
+
+from video_features_tpu import native
+from video_features_tpu.utils.io import Prefetcher
+from video_features_tpu.utils import sinks
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.mark.parametrize("arr", [
+    np.arange(12, dtype=np.float32).reshape(3, 4),
+    np.arange(5, dtype=np.int64),
+    np.float64(3.25),                      # 0-d
+    np.zeros((2, 0, 3), dtype=np.float32),  # empty
+    np.array([[True, False], [False, True]]),
+    np.random.default_rng(0).normal(size=(7, 13, 2)).astype(np.float16),
+])
+def test_write_npy_atomic_roundtrip(tmp_path, arr):
+    f = str(tmp_path / "x.npy")
+    assert native.write_npy_atomic(f, arr)
+    back = np.load(f)
+    assert back.dtype == np.asanyarray(arr).dtype
+    assert back.shape == np.asanyarray(arr).shape
+    np.testing.assert_array_equal(back, np.asanyarray(arr))
+    assert native.validate_npy(f) is True
+    assert not list(tmp_path.glob("*.tmp.*"))  # no temp litter
+
+
+def test_write_npy_appends_extension(tmp_path):
+    f = str(tmp_path / "noext")
+    assert native.write_npy_atomic(f, np.ones(3))
+    np.testing.assert_array_equal(np.load(f + ".npy"), np.ones(3))
+
+
+def test_validate_npy_accepts_numpy_written_files(tmp_path):
+    f = str(tmp_path / "np.npy")
+    np.save(f, np.arange(10, dtype=np.int32))
+    assert native.validate_npy(f) is True
+
+
+def test_validate_npy_detects_truncation(tmp_path):
+    f = str(tmp_path / "t.npy")
+    np.save(f, np.arange(1000, dtype=np.float64))
+    data = open(f, "rb").read()
+    open(f, "wb").write(data[:len(data) // 2])  # simulate a partial write
+    assert native.validate_npy(f) is False
+
+
+def test_validate_npy_rejects_garbage(tmp_path):
+    f = str(tmp_path / "g.npy")
+    open(f, "wb").write(b"not a numpy file at all")
+    assert native.validate_npy(f) is False
+
+
+def test_object_arrays_fall_back(tmp_path):
+    assert not native.write_npy_atomic(
+        str(tmp_path / "o.npy"), np.array([{"a": 1}], dtype=object))
+
+
+def test_is_already_exist_uses_validator(tmp_path):
+    """A truncated .npy must be treated as absent (re-extract), a valid one
+    as present — through the real sinks entry point."""
+    out = tmp_path
+    video = "/some/video.mp4"
+    good = sinks.make_path(str(out), video, "feat", ".npy")
+    np.save(good, np.ones((4, 8)))
+    assert sinks.is_already_exist("save_numpy", str(out), video, ["feat"])
+    data = open(good, "rb").read()
+    open(good, "wb").write(data[:-5])
+    assert not sinks.is_already_exist("save_numpy", str(out), video, ["feat"])
+
+
+def test_prefetcher_matches_direct_iteration():
+    items = [np.full((4,), i) for i in range(17)]
+    got = list(Prefetcher(items, depth=3))
+    assert len(got) == 17
+    for a, b in zip(got, items):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetcher_propagates_exceptions():
+    def gen():
+        yield 1
+        raise RuntimeError("decode failed")
+
+    it = iter(Prefetcher(gen()))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_prefetcher_exception_with_full_queue_and_slow_consumer():
+    """The producer's exception must survive a full queue (regression: it
+    used to be dropped after a 1 s timeout, hanging the consumer)."""
+    import time
+
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("decode failed late")
+
+    got = []
+    with pytest.raises(RuntimeError, match="decode failed late"):
+        for item in Prefetcher(gen(), depth=1):
+            time.sleep(0.3)  # keep the queue full while the producer raises
+            got.append(item)
+    assert got == [1, 2]
+
+
+def test_prefetcher_abandoned_consumer_does_not_hang():
+    import threading
+    started = threading.Event()
+
+    def gen():
+        started.set()
+        for i in range(10_000):
+            yield i
+
+    it = iter(Prefetcher(gen(), depth=1))
+    assert next(it) == 0
+    it.close()  # generator close triggers the finally/stop path
+    assert started.is_set()

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..config import Config
 from ..parallel.mesh import DataParallelApply
-from ..utils.io import VideoSource
+from ..utils.io import Prefetcher, VideoSource
 from ..utils import flow_viz
 from .base import BaseExtractor
 
@@ -69,7 +69,8 @@ class OpticalFlowExtractor(BaseExtractor):
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         first = True
-        for batch, ts, _ in video:
+        # decode-ahead: the next batch decodes while this one is on-device
+        for batch, ts, _ in Prefetcher(video):
             if len(batch) < 2:
                 # a single-frame video (or trailing lone frame in the first
                 # batch) yields no pairs

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..config import Config
 from ..parallel.mesh import DataParallelApply
-from ..utils.io import VideoSource
+from ..utils.io import Prefetcher, VideoSource
 from .base import BaseExtractor
 
 
@@ -55,7 +55,8 @@ class FrameWiseExtractor(BaseExtractor):
         )
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
-        for batch, times, _ in video:
+        # decode-ahead: the next batch decodes while this one is on-device
+        for batch, times, _ in Prefetcher(video):
             arr = np.stack(batch)  # runner pads ragged tails to fixed_batch
             feats = self.runner(arr)
             self.maybe_show_pred(feats)

@@ -242,6 +242,64 @@ class VideoSource:
             yield batch, times, indices
 
 
+class Prefetcher:
+    """Decode-ahead iterator: runs ``iterable`` on a background thread into a
+    bounded queue so host-side decode overlaps device compute.
+
+    The reference pipeline is strictly serial — decode a batch, forward it,
+    decode the next (reference models/_base/base_framewise_extractor.py:
+    47-88). cv2 releases the GIL during decode, so one producer thread gives
+    true overlap; ``depth`` bounds memory. Producer exceptions are re-raised
+    in the consumer; an abandoned consumer unblocks the producer via the stop
+    flag (checked on every bounded put).
+    """
+
+    _DONE = object()
+
+    def __init__(self, iterable, depth: int = 2):
+        self.iterable = iterable
+        self.depth = depth
+
+    def __iter__(self):
+        import queue as _queue
+        import threading
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def put_until_stopped(item) -> bool:
+            """Bounded put that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self.iterable:
+                    if not put_until_stopped(item):
+                        return
+                put_until_stopped(self._DONE)
+            except BaseException as e:  # re-raised consumer-side
+                put_until_stopped(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+
 def read_video_frames(path: Union[str, Path],
                       fps: Optional[float] = None,
                       total: Optional[int] = None) -> Tuple[np.ndarray, float]:

@@ -39,6 +39,11 @@ def load_numpy(fpath):
 
 
 def write_numpy(fpath, value):
+    from .. import native
+    # temp-file + fsync + atomic rename (native/vft_native.cpp): a preempted
+    # worker can never leave a half-written feature file behind
+    if native.write_npy_atomic(fpath, value):
+        return
     return np.save(fpath, value)
 
 
@@ -67,16 +72,27 @@ def is_already_exist(on_extraction: str, output_path: str, video_path: str,
     ext = EXTS[on_extraction]
     loader = load_numpy if on_extraction == "save_numpy" else load_pickle
 
+    from .. import native
+
     how_many_files_should_exist = len(output_feat_keys)
     existing = 0
     for key in output_feat_keys:
         fpath = make_path(output_path, video_path, key, ext)
         if os.path.exists(fpath):
-            try:
-                loader(fpath)
+            # O(header) structural check (native/vft_native.cpp) instead of
+            # loading the whole array; None = cannot judge -> full load
+            verdict = (native.validate_npy(fpath)
+                       if on_extraction == "save_numpy" else None)
+            if verdict is True:
                 existing += 1
-            except Exception:
+            elif verdict is False:
                 print(f"Failed to load: {fpath}. Will extract again.")
+            else:
+                try:
+                    loader(fpath)
+                    existing += 1
+                except Exception:
+                    print(f"Failed to load: {fpath}. Will extract again.")
     if existing == how_many_files_should_exist:
         print(f'Features for "{video_path}" already exist in "{output_path}" — skipping. '
               "Use a different `output_path` to extract again.")

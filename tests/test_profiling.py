@@ -1,0 +1,83 @@
+"""Stage profiler unit tests + CLI integration."""
+import time
+
+import numpy as np
+import pytest
+
+from video_features_tpu.utils.profiling import StageProfiler, TraceCapture
+
+
+def test_disabled_profiler_records_nothing():
+    p = StageProfiler()
+    with p.stage("x"):
+        pass
+    assert p.snapshot() == {}
+    assert "no stages" in p.summary()
+
+
+def test_stage_accumulation_and_summary():
+    p = StageProfiler()
+    p.enabled = True
+    for _ in range(3):
+        with p.stage("decode"):
+            time.sleep(0.01)
+    with p.stage("forward"):
+        time.sleep(0.03)
+    snap = p.snapshot()
+    assert snap["decode"][1] == 3
+    assert snap["forward"][1] == 1
+    assert snap["decode"][0] >= 0.03
+    s = p.summary("t")
+    assert "decode" in s and "forward" in s and "%" in s
+    p.reset()
+    assert p.snapshot() == {}
+
+
+def test_stage_records_on_exception():
+    p = StageProfiler()
+    p.enabled = True
+    with pytest.raises(ValueError):
+        with p.stage("boom"):
+            raise ValueError
+    assert p.snapshot()["boom"][1] == 1
+
+
+def test_stage_thread_safety():
+    import threading
+    p = StageProfiler()
+    p.enabled = True
+
+    def work():
+        for _ in range(200):
+            with p.stage("s"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert p.snapshot()["s"][1] == 800
+
+
+def test_trace_capture_noop_without_dir():
+    with TraceCapture(None):
+        pass  # must not touch jax.profiler
+
+
+def test_cli_profile_flag_prints_breakdown(tmp_path, sample_video, capsys):
+    from video_features_tpu import cli
+    from video_features_tpu.utils.profiling import profiler
+    try:
+        cli.main([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=1", "allow_random_weights=true",
+            "on_extraction=save_numpy", f"output_path={tmp_path}/out",
+            f"tmp_path={tmp_path}/tmp", f"video_paths={sample_video}",
+            "profile=true",
+        ])
+        out = capsys.readouterr().out
+        assert "[profile: resnet" in out
+        for stage in ("decode", "forward", "write"):
+            assert stage in out, f"missing stage {stage} in breakdown"
+    finally:
+        profiler.enabled = False
+        profiler.reset()

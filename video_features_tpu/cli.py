@@ -39,9 +39,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     from .parallel.mesh import local_shard_of_list
     video_paths = local_shard_of_list(video_paths)
 
-    for video_path in tqdm(video_paths):
-        safe_extract(extractor._extract, video_path)
+    # profile=true: per-stage decode/forward/write breakdown at the end;
+    # profile_trace_dir=/path: additionally capture a jax.profiler trace
+    from .utils.profiling import TraceCapture, profiler
+    profiler.enabled = bool(args.get("profile", False))
+    profiler.reset()  # the profiler is process-global; in-process re-runs
+    # (library use, tests) must not inherit the previous run's stats
 
+    with TraceCapture(args.get("profile_trace_dir")):
+        for video_path in tqdm(video_paths):
+            safe_extract(extractor._extract, video_path)
+
+    if profiler.enabled:
+        print(profiler.summary(f"profile: {args.feature_type} x "
+                               f"{len(video_paths)} videos"))
     if verbose:
         print(f"Yay! Done! The results are in {args.output_path}")
 

@@ -44,6 +44,10 @@ class ClipStackExtractor(BaseExtractor):
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
                           transform=self.host_transform)
+        # no Prefetcher here: slices may overlap (step < stack), so every
+        # frame is needed before the first forward — there is no compute to
+        # overlap the decode with (reference r21d/s3d read the whole video
+        # up front too, extract_r21d.py:75)
         frames = [f for f, _, _ in src.frames()]
         slices = form_slices(len(frames), self.stack_size, self.step_size)
         vid_feats: List[np.ndarray] = []

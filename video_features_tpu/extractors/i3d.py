@@ -28,7 +28,7 @@ from ..config import Config
 from ..models import i3d as i3d_model
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, get_mesh
-from ..utils.io import VideoSource
+from ..utils.io import Prefetcher, VideoSource
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
 from .base import BaseExtractor
@@ -117,7 +117,9 @@ class ExtractI3D(BaseExtractor):
                 feats[stream].extend(list(out))
             stacks_done += len(group)
 
-        for frame, _, idx in src.frames():
+        # decode-ahead roughly one stack while the previous stack is on-device
+        for frame, _, idx in Prefetcher(src.frames(),
+                                        depth=max(2, self.stack_size)):
             frames.append(frame)
             if len(frames) - 1 == self.stack_size:
                 stacks.append(np.stack(frames))

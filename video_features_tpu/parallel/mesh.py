@@ -112,6 +112,7 @@ class DataParallelApply:
         Pads up to ``fixed_batch`` (if set — one executable per video) and
         then to a mesh-divisible size, drops padded rows after execution.
         """
+        from ..utils.profiling import profiler
         n = batch_np.shape[0] if n_valid is None else n_valid
         target = max(batch_np.shape[0], self.fixed_batch or 0)
         full = self.padded_batch_size(target)
@@ -119,5 +120,8 @@ class DataParallelApply:
             pad_width = [(0, full - batch_np.shape[0])] + \
                         [(0, 0)] * (batch_np.ndim - 1)
             batch_np = np.pad(batch_np, pad_width)
-        out = self._fn(self.params, batch_np)
-        return np.asarray(out)[:n]
+        # np.asarray blocks on the device->host copy, so this stage is true
+        # H2D + forward + D2H wall time
+        with profiler.stage("forward"):
+            out = self._fn(self.params, batch_np)
+            return np.asarray(out)[:n]

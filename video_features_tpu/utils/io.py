@@ -181,18 +181,24 @@ class VideoSource:
         batched ``__iter__`` path — the two views must agree or per-frame
         resize/crop would silently be skipped for one of them.
         """
+        from .profiling import profiler
         stream = _FrameStream(self.path)
         tf = self.transform
 
         def emit(rgb, out_idx):
-            x = tf(rgb) if tf is not None else rgb
+            with profiler.stage("decode"):
+                x = tf(rgb) if tf is not None else rgb
             return x, out_idx / self.fps * 1000.0, out_idx
+
+        def timed_read():
+            with profiler.stage("decode"):
+                return stream.read()
 
         try:
             if self.index_map is None:
                 out_idx = 0
                 while True:
-                    rgb = stream.read()
+                    rgb = timed_read()
                     if rgb is None:
                         return
                     yield emit(rgb, out_idx)
@@ -202,7 +208,7 @@ class VideoSource:
                 current = None
                 for out_idx, want in enumerate(self.index_map):
                     while src_idx < want:
-                        nxt = stream.read()
+                        nxt = timed_read()
                         if nxt is None:
                             # container metadata overstated the frame count;
                             # reaching stream end inside this loop always

@@ -1,0 +1,99 @@
+"""Per-stage timing + XLA trace capture.
+
+The reference has no observability beyond tqdm and print (SURVEY §5:
+"Tracing / profiling: absent", reference main.py:14-18 "TODO: logging").
+Here profiling is a first-class subsystem:
+
+  - :data:`profiler` — a process-global stage timer. Pipelines wrap their
+    hot phases in ``with profiler.stage("decode")`` etc.; when disabled the
+    context manager is a no-op (two attribute reads), so instrumentation
+    stays in place permanently. Stages used by the built-in pipelines:
+    ``decode`` (cv2 read + host transform), ``forward`` (H2D + jitted
+    forward + D2H: the DataParallelApply call blocks on the host copy, so
+    this is true device wall time), ``write`` (sink IO).
+  - ``profile=true`` on the CLI prints the aggregate per-stage breakdown at
+    the end of the run — the decode-vs-forward-vs-write split that tells
+    you whether the chip or the host is the bottleneck.
+  - ``profile_trace_dir=/path`` additionally captures a ``jax.profiler``
+    trace (one per run) viewable in TensorBoard/Perfetto, with device-side
+    op timelines.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class StageProfiler:
+    """Accumulates wall time and call counts per named stage."""
+
+    def __init__(self) -> None:
+        import threading
+        self.enabled = False
+        self._lock = threading.Lock()  # decode runs in the Prefetcher thread
+        self._times: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._times[name] += dt
+                self._counts[name] += 1
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        return {k: (self._times[k], self._counts[k]) for k in self._times}
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._counts.clear()
+
+    def summary(self, title: str = "profile") -> str:
+        """Stages can overlap in wall time (decode runs in the Prefetcher
+        thread while forward runs on the main thread), so the accounted
+        total can exceed wall clock — that overlap is the pipeline working
+        as designed."""
+        if not self._times:
+            return f"[{title}] no stages recorded"
+        total = sum(self._times.values())
+        lines = [f"[{title}] total accounted: {total:.3f}s"]
+        for name, t in sorted(self._times.items(), key=lambda kv: -kv[1]):
+            n = self._counts[name]
+            lines.append(
+                f"  {name:<10} {t:8.3f}s  {100 * t / total:5.1f}%  "
+                f"{n:6d} calls  {1e3 * t / max(n, 1):8.3f} ms/call")
+        return "\n".join(lines)
+
+
+profiler = StageProfiler()
+
+
+class TraceCapture:
+    """``jax.profiler`` trace over a region, no-op when dir is None."""
+
+    def __init__(self, trace_dir: Optional[str]) -> None:
+        self.trace_dir = trace_dir
+        self._active = False
+
+    def __enter__(self):
+        if self.trace_dir:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+        return False

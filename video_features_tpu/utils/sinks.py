@@ -123,6 +123,8 @@ def action_on_extraction(feats_dict: Dict[str, np.ndarray],
     if on_extraction not in EXTS:
         raise NotImplementedError(f"on_extraction: {on_extraction}")
 
+    from .profiling import profiler
+
     os.makedirs(output_path, exist_ok=True)
     writer = write_numpy if on_extraction == "save_numpy" else write_pickle
     for key, value in feats_dict.items():
@@ -130,7 +132,8 @@ def action_on_extraction(feats_dict: Dict[str, np.ndarray],
         arr = np.asarray(value)
         if arr.size == 0:
             print("Warning: the value is empty for", key, "@", video_path)
-        writer(fpath, value)
+        with profiler.stage("write"):
+            writer(fpath, value)
 
 
 def safe_extract(extract_fn, video_path: str) -> bool:

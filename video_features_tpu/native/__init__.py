@@ -86,8 +86,9 @@ def available() -> bool:
 
 def write_npy_atomic(fpath: str, value) -> bool:
     """Write ``value`` as .npy with atomic replace. Returns False when the
-    native path cannot handle it (object dtype, Fortran order, no lib) —
-    callers fall back to np.save."""
+    native path cannot handle it (object/structured dtype, or the library
+    is unavailable) — callers fall back to np.save. Non-contiguous inputs
+    are copied to C order first."""
     lib = _load()
     if lib is None:
         return False

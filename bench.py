@@ -29,9 +29,14 @@ def bench_ours() -> float:
     import jax.numpy as jnp
     from video_features_tpu.models.r21d import R2Plus1D, R21D_MEAN, R21D_STD
 
+    from video_features_tpu.parallel.mesh import cast_floating
+
     model = R2Plus1D("r2plus1d_18_16_kinetics")
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 4, 112, 112, 3)))["params"]
+    # bf16 params + bf16 activations: with f32 params flax would promote every
+    # conv back to f32, halving MXU throughput (parallel/mesh.py cast_floating)
+    params = cast_floating(params, jnp.bfloat16)
 
     @jax.jit
     def forward(p, batch_u8):

@@ -1,0 +1,47 @@
+"""Mesh / data-parallel runner: param casting, sharded padding. Torch-free —
+these must run on environments without torch (the TPU production target)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.models import r21d as r21d_model
+from video_features_tpu.parallel.mesh import (DataParallelApply,
+                                              cast_floating, get_mesh)
+
+
+def test_cast_floating_casts_floats_only():
+    tree = {"w": np.ones((2, 2), np.float32), "idx": np.arange(3)}
+    out = cast_floating(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    # stays integral (jnp.asarray may narrow int64->int32 under x64-disabled)
+    assert jnp.issubdtype(out["idx"].dtype, jnp.integer)
+
+
+def test_bfloat16_precision_casts_params_and_stays_close():
+    """precision=bfloat16 must actually run the net in bf16 (flax promotes a
+    bf16 activation against f32 params back to f32, so DataParallelApply casts
+    the param tree — parallel/mesh.py cast_floating) while staying close to
+    the f32 features."""
+    model = r21d_model.R2Plus1D("r2plus1d_18_16_kinetics")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4, 32, 32, 3)))["params"]
+    casted = cast_floating(params, jnp.bfloat16)
+    assert all(x.dtype == jnp.bfloat16
+               for x in jax.tree_util.tree_leaves(casted))
+
+    x = np.random.default_rng(0).uniform(size=(2, 4, 32, 32, 3)) \
+        .astype(np.float32)
+    mesh = get_mesh(n_devices=1)
+
+    def fwd(dtype):
+        def f(p, batch):
+            return model.apply({"params": p},
+                               batch.astype(dtype)).astype(jnp.float32)
+        return f
+
+    f32 = DataParallelApply(fwd(jnp.float32), params, mesh=mesh)(x)
+    bf16 = DataParallelApply(fwd(jnp.bfloat16), casted, mesh=mesh)(x)
+    cos = np.sum(f32 * bf16, axis=1) / (
+        np.linalg.norm(f32, axis=1) * np.linalg.norm(bf16, axis=1) + 1e-9)
+    assert np.all(cos > 0.99), f"bf16 features diverged: cos={cos}"

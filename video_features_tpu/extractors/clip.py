@@ -24,7 +24,7 @@ import numpy as np
 from ..config import Config
 from ..models import clip as clip_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import KINETICS_CLASS_PATH, show_predictions_on_dataset
 from ..weights import store
 from .frame_wise import FrameWiseExtractor
@@ -76,7 +76,8 @@ class ExtractCLIP(FrameWiseExtractor):
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.runner = DataParallelApply(
-            partial(_encode_image, self.model, dtype), params,
+            partial(_encode_image, self.model, dtype),
+            cast_floating(params, dtype),
             mesh=mesh, fixed_batch=self.batch_size)
 
         input_size = self.cfg.image_resolution

@@ -27,7 +27,7 @@ import numpy as np
 from ..config import Config
 from ..models import i3d as i3d_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.io import Prefetcher, VideoSource
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
@@ -75,6 +75,8 @@ class ExtractI3D(BaseExtractor):
                 "i3d_rgb", partial(i3d_model.init_params, "rgb"),
                 i3d_model.params_from_torch, weights_path=weights_path,
                 allow_random=allow_random)
+            # cast once for both runners
+            params = cast_floating(params, dtype)
             self.runners["rgb"] = DataParallelApply(
                 partial(_i3d_forward, self.model, dtype, True),
                 params, mesh=mesh, fixed_batch=self.clip_batch_size)

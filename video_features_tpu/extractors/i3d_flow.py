@@ -31,7 +31,7 @@ import numpy as np
 
 from ..models import i3d as i3d_model
 from ..models import raft as raft_model
-from ..parallel.mesh import DataParallelApply
+from ..parallel.mesh import DataParallelApply, cast_floating
 from ..weights import store
 
 
@@ -101,6 +101,8 @@ class FlowStream:
             i3d_model.params_from_torch,
             weights_path=args.get("flow_weights_path"),
             allow_random=allow_random)
+        # cast once for both runners
+        i3d_params = cast_floating(i3d_params, dtype)
         self.runner = DataParallelApply(
             partial(_i3d_forward, parent.model, dtype, True),
             i3d_params, mesh=mesh, fixed_batch=parent.clip_batch_size)

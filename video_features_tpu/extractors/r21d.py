@@ -17,7 +17,7 @@ import numpy as np
 from ..config import Config
 from ..models import r21d as r21d_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
 from .clip_stack import ClipStackExtractor
@@ -61,7 +61,8 @@ class ExtractR21D(ClipStackExtractor):
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.runner = DataParallelApply(
             partial(_device_forward, self.model, dtype),
-            params["backbone"], mesh=mesh, fixed_batch=self.clip_batch_size)
+            cast_floating(params["backbone"], dtype),
+            mesh=mesh, fixed_batch=self.clip_batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             x = rgb.astype(np.float32) / 255.0

@@ -15,7 +15,7 @@ import numpy as np
 from ..config import Config
 from ..models import resnet as resnet_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
 from .frame_wise import FrameWiseExtractor
@@ -56,7 +56,8 @@ class ExtractResNet(FrameWiseExtractor):
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.runner = DataParallelApply(
             partial(_device_forward, self.model, dtype),
-            params["backbone"], mesh=mesh, fixed_batch=self.batch_size)
+            cast_floating(params["backbone"], dtype),
+            mesh=mesh, fixed_batch=self.batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, 256, interpolation="bilinear")

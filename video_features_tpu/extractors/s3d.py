@@ -16,7 +16,7 @@ import numpy as np
 from ..config import Config
 from ..models import s3d as s3d_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
 from .clip_stack import ClipStackExtractor
@@ -41,10 +41,11 @@ class ExtractS3D(ClipStackExtractor):
             s3d_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
-        self.params = params
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        # cast once for both runners
+        params = cast_floating(params, dtype)
         self.runner = DataParallelApply(
             partial(_device_forward, self.model, dtype, True),
             params, mesh=mesh, fixed_batch=self.clip_batch_size)

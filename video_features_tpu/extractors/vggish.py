@@ -25,7 +25,7 @@ import numpy as np
 from ..config import Config
 from ..models import vggish as vggish_model
 from ..ops import audio
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.io import extract_wav_from_mp4
 from ..weights import store
 from .base import BaseExtractor
@@ -55,7 +55,8 @@ class ExtractVGGish(BaseExtractor):
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype), params,
+            partial(_device_forward, self.model, dtype),
+            cast_floating(params, dtype),
             mesh=mesh, fixed_batch=self.batch_size)
 
         # PCA+quantize postprocessing is identity-by-default in the reference

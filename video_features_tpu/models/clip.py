@@ -8,10 +8,12 @@ transformer with causal mask (:195-203, :328-334), ``QuickGELU``
 (:58-93, query = the mean token).
 
 Design notes (TPU):
-  - parameters are kept float32 (the OpenAI checkpoints ship fp16 tensors
-    for conv/linear — model.py:375-396; the converter upcasts). Compute can
-    run bfloat16 via the extractor's ``precision`` knob; LayerNorms always
-    compute in float32, mirroring the reference's fp16-safe LayerNorm.
+  - the converter upcasts the OpenAI checkpoints' fp16 conv/linear tensors
+    (model.py:375-396) to float32. With the extractor's ``precision=bfloat16``
+    knob both params and activations are cast to bf16 for inference
+    (parallel/mesh.py cast_floating) — except the show_pred text path, which
+    reads the pre-cast f32 tree; LayerNorms always compute in float32,
+    mirroring the reference's fp16-safe LayerNorm.
   - attention is implemented with packed-per-head einsums that XLA maps onto
     the MXU; the (77, 77) causal mask is an additive constant folded into
     the compiled program.

@@ -69,6 +69,22 @@ def local_shard_of_list(items: Sequence[str], host_id: Optional[int] = None,
     return out
 
 
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast every floating-point leaf of a param tree to ``dtype``.
+
+    This is what makes ``precision=bfloat16`` real on TPU: flax modules with
+    ``dtype=None`` promote inputs and params to a common type, so a bf16
+    activation against f32 params silently runs the conv/matmul in f32 on the
+    MXU. Casting the params (the standard bf16-inference layout) keeps the
+    whole network in bf16; norm internals still accumulate in f32
+    (models/common.py BNInf rsqrt).
+    """
+    def cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+    return jax.tree_util.tree_map(cast, tree)
+
+
 class DataParallelApply:
     """Jitted, batch-sharded wrapper around ``apply_fn(params, batch)``.
 

@@ -1,0 +1,69 @@
+"""I420 wire format: device conversion parity vs cv2, ingest-mode feature
+consistency on the flagship R(2+1)D path."""
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.ops import colorspace as cs  # noqa: E402
+
+
+def test_packed_roundtrip_matches_cv2():
+    rng = np.random.default_rng(1)
+    frame = rng.integers(0, 256, size=(112, 112, 3), dtype=np.uint8)
+    packed = cs.rgb_to_yuv420(frame)
+    assert packed.shape == (cs.packed_size(112, 112),)
+    want = cv2.cvtColor(packed.reshape(168, 112),
+                        cv2.COLOR_YUV2RGB_I420).astype(np.float32)
+    got = np.asarray(cs.yuv420_packed_to_rgb(packed[None], 112, 112))[0]
+    assert got.shape == (112, 112, 3)
+    # same studio-swing BT.601 + nearest chroma upsample as cv2; <1 level
+    assert np.abs(got - want).max() < 1.0
+
+
+def test_odd_dims_rejected():
+    with pytest.raises(ValueError):
+        cs.packed_size(113, 112)
+
+
+def test_natural_frame_chroma_loss_is_small(sample_video):
+    """On real video (already 4:2:0 at the codec level) the re-subsampled
+    chroma loses almost nothing."""
+    cap = cv2.VideoCapture(sample_video)
+    ok, bgr = cap.read()
+    cap.release()
+    assert ok
+    rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)[:224, :224]
+    got = np.asarray(cs.yuv420_packed_to_rgb(
+        cs.rgb_to_yuv420(rgb)[None], 224, 224))[0]
+    err = np.abs(got - rgb.astype(np.float32))
+    assert err.mean() < 2.0, f"mean abs err {err.mean()}"
+
+
+@pytest.mark.parametrize("ingest", ["uint8", "yuv420"])
+def test_r21d_ingest_modes_match_float32(sample_video, tmp_path, ingest):
+    """The compressed wire formats must reproduce the float32 path's features
+    (random weights, natural frames): cosine > 0.99."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.r21d import ExtractR21D
+
+    def run(mode, sub):
+        cfg = load_config("r21d", {
+            "video_paths": sample_video, "device": "cpu",
+            "extraction_fps": 2, "stack_size": 8, "step_size": 8,
+            "clip_batch_size": 2, "ingest": mode,
+            "allow_random_weights": True,
+            "output_path": str(tmp_path / sub / "o"),
+            "tmp_path": str(tmp_path / sub / "t"),
+        })
+        sanity_check(cfg)
+        return ExtractR21D(cfg).extract(sample_video)["r21d"]
+
+    ref = run("float32", "f32")
+    got = run(ingest, ingest)
+    assert got.shape == ref.shape and ref.shape[0] > 0
+    cos = np.sum(ref * got, axis=1) / (
+        np.linalg.norm(ref, axis=1) * np.linalg.norm(got, axis=1) + 1e-9)
+    assert np.all(cos > 0.99), f"{ingest} features diverged: cos={cos}"

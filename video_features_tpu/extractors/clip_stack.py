@@ -3,11 +3,13 @@
 Re-design of the reference's whole-video + per-slice serial loop
 (reference models/r21d/extract_r21d.py:60-94, models/s3d/extract_s3d.py:40-75):
 
-  host:   stream-decode -> per-frame resize/crop -> (T, H, W, 3) float32
+  host:   stream-decode -> per-frame resize/crop -> per-frame wire array
+          (float32 (H, W, 3) by default; uint8, or packed-I420 uint8
+          (H*W*3/2,), under the compressed ingest modes)
           -> `form_slices` windows (trailing partial stack dropped, same
           observable contract as reference utils/utils.py:59-68)
-  device: (clip_batch, stack, H, W, 3) fixed-shape jitted forward, the
-          clip-batch axis sharded over the mesh's data axis.
+  device: (clip_batch, stack, *frame_wire_shape) fixed-shape jitted forward,
+          the clip-batch axis sharded over the mesh's data axis.
 
 Where the reference runs batch=1 slices sequentially (extract_r21d.py:84-88),
 clips here are batched into one jitted call — each 3D-conv matmul gets a
@@ -30,6 +32,14 @@ from .base import BaseExtractor
 class ClipStackExtractor(BaseExtractor):
     """Families plug in ``host_transform``, ``runner``, defaults, show_pred."""
 
+    #: host->device wire formats a family supports. The pipeline is
+    #: H2D-bandwidth-bound, so precision=bfloat16 defaults to uint8 (3 B/px;
+    #: <=1/510 quantization noise, below bf16 input rounding) instead of
+    #: float32 (12 B/px, the bit-exact golden default). Families may add
+    #: opt-in 'yuv420' (packed I420, 1.5 B/px, colorspace on device — the
+    #: maximum-throughput mode bench.py measures).
+    supported_ingest = ("uint8", "float32")
+
     def __init__(self, args: Config, default_stack: int, default_step: int) -> None:
         super().__init__(args)
         self.model_name = args.get("model_name")
@@ -40,6 +50,12 @@ class ClipStackExtractor(BaseExtractor):
         self.output_feat_keys = [self.feature_type]
         self.host_transform: Optional[Callable] = None
         self.runner: Optional[DataParallelApply] = None
+        self.ingest = args.get("ingest") or (
+            "uint8" if self.precision == "bfloat16" else "float32")
+        if self.ingest not in self.supported_ingest:
+            raise NotImplementedError(
+                f"ingest={self.ingest!r}; {type(self).__name__} supports "
+                f"{self.supported_ingest}")
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
@@ -52,7 +68,7 @@ class ClipStackExtractor(BaseExtractor):
         slices = form_slices(len(frames), self.stack_size, self.step_size)
         vid_feats: List[np.ndarray] = []
         if slices:
-            all_frames = np.stack(frames)  # (T, H, W, 3)
+            all_frames = np.stack(frames)  # (T, *frame_wire_shape)
             for i in range(0, len(slices), self.clip_batch_size):
                 # materialize only this group's windows: with overlapping
                 # windows (step < stack) stacking all of them up front would

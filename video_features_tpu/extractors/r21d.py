@@ -16,6 +16,7 @@ import numpy as np
 
 from ..config import Config
 from ..models import r21d as r21d_model
+from ..ops import colorspace
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
@@ -24,14 +25,32 @@ from .clip_stack import ClipStackExtractor
 
 
 def _device_forward(model: r21d_model.R2Plus1D, dtype, params, batch):
-    """(B, T, 112, 112, 3) float [0,1] -> (B, 512); K400-normalize fused."""
+    """(B, T, 112, 112, 3) float [0,1] or uint8 -> (B, 512).
+
+    /255 (uint8 wire format only), K400-normalize, backbone — all fused by
+    XLA into the stem conv. The dtype branch is resolved at trace time.
+    """
+    if batch.dtype == jnp.uint8:
+        batch = batch.astype(jnp.float32) / 255.0
     x = (batch - jnp.asarray(r21d_model.R21D_MEAN, batch.dtype)) / \
         jnp.asarray(r21d_model.R21D_STD, batch.dtype)
     x = x.astype(dtype)
     return model.apply({"params": params}, x).astype(jnp.float32)
 
 
+def _device_forward_yuv420(model: r21d_model.R2Plus1D, dtype, params, batch):
+    """Packed-I420 uint8 (B, T, 112*112*3/2) -> (B, 512).
+
+    On-device colorspace conversion (ops/colorspace.py) into the shared
+    normalize + backbone; the wire carries 1.5 bytes/pixel instead of 3.
+    """
+    rgb = colorspace.yuv420_packed_to_rgb(batch, 112, 112) / 255.0
+    return _device_forward(model, dtype, params, rgb)
+
+
 class ExtractR21D(ClipStackExtractor):
+
+    supported_ingest = ("yuv420", "uint8", "float32")
 
     def __init__(self, args: Config) -> None:
         if args.model_name not in r21d_model.VARIANTS:
@@ -59,15 +78,23 @@ class ExtractR21D(ClipStackExtractor):
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        fwd = (_device_forward_yuv420 if self.ingest == "yuv420"
+               else _device_forward)
         self.runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype),
+            partial(fwd, self.model, dtype),
             cast_floating(params["backbone"], dtype),
             mesh=mesh, fixed_batch=self.clip_batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             x = rgb.astype(np.float32) / 255.0
             x = pp.bilinear_resize_no_antialias(x, (128, 171))
-            return pp.center_crop(x, 112)
+            x = pp.center_crop(x, 112)
+            if self.ingest == "float32":
+                return x
+            u8 = pp.quantize_u8(x)
+            if self.ingest == "uint8":
+                return u8
+            return colorspace.rgb_to_yuv420(u8)
 
         self.host_transform = transform
 

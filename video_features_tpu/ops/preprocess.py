@@ -82,6 +82,16 @@ def center_crop(img: np.ndarray, crop: Union[int, Tuple[int, int]]) -> np.ndarra
     return img[i:i + th, j:j + tw]
 
 
+def quantize_u8(x: np.ndarray) -> np.ndarray:
+    """[0, 1] float -> uint8 wire format (round-to-nearest, clipped).
+
+    Quantization noise is <=1/510 per channel — below bfloat16 input
+    rounding — so the bf16 production pipeline ships 1 byte/pixel/channel
+    to the device instead of 4 (H2D bandwidth is the pipeline bottleneck).
+    """
+    return np.clip(np.round(x * 255.0), 0, 255).astype(np.uint8)
+
+
 def tensor_center_crop(img: np.ndarray, crop_size: int) -> np.ndarray:
     """Floor-division center crop (reference models/transforms.py:132-143).
 

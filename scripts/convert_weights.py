@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Ahead-of-time weight conversion: torch checkpoints -> flax msgpack.
+
+The extractors convert lazily on first use (weights/store.py resolve_params)
+— this script does the same conversion up front, so TPU workers start from
+the cached ``{model_key}.msgpack`` without importing torch at all.
+
+Usage:
+  # convert one checkpoint you downloaded yourself
+  python scripts/convert_weights.py --model-key raft_sintel \\
+      --ckpt /path/to/raft-sintel.pth
+
+  # scan VFT_WEIGHTS_DIR + the torch hub cache and convert everything found
+  python scripts/convert_weights.py --all
+
+  # list every known model key and its accepted source filenames
+  python scripts/convert_weights.py --list
+
+Source checkpoints are the reference's own (SURVEY §2.5): torchvision /
+torch.hub files, the OpenAI CLIP CDN archives, the torchvggish GitHub
+release, and the repo-local .pt/.pth files. Converted trees land in
+VFT_WEIGHTS_DIR (default ~/.cache/video_features_tpu).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model-key", help="one key from --list")
+    ap.add_argument("--ckpt", help="explicit source checkpoint path")
+    ap.add_argument("--all", action="store_true",
+                    help="convert every key whose source checkpoint is found")
+    ap.add_argument("--list", action="store_true", dest="list_keys",
+                    help="print known model keys + accepted filenames")
+    args = ap.parse_args()
+
+    from video_features_tpu.weights import store
+    from video_features_tpu.weights.converters import registry
+
+    reg = registry()
+    if args.list_keys:
+        for key in sorted(reg):
+            names = ", ".join(store.HUB_FILENAMES.get(key, ("(any)",)))
+            print(f"{key:35s} {names}")
+        return 0
+
+    keys = [args.model_key] if args.model_key else (
+        sorted(reg) if args.all else [])
+    if not keys:
+        ap.error("need --model-key, --all, or --list")
+    if args.ckpt and not args.model_key:
+        ap.error("--ckpt requires --model-key (one checkpoint, one family)")
+    unknown = [k for k in keys if k not in reg]
+    if unknown:
+        ap.error(f"unknown model key(s): {unknown}; see --list")
+
+    converted, skipped = 0, 0
+    for key in keys:
+        init_fn, convert_fn = reg[key]
+        src = store.find_checkpoint(key, args.ckpt)
+        if src is None:
+            print(f"-- {key}: no source checkpoint found, skipping")
+            skipped += 1
+            continue
+        if src.suffix == ".msgpack" and not args.ckpt:
+            print(f"ok {key}: already converted ({src})")
+            continue
+        params = store.resolve_params(key, init_fn, convert_fn,
+                                      weights_path=args.ckpt)
+        out = store.weights_dir() / f"{key}.msgpack"
+        if args.ckpt:
+            # resolve_params skips caching for explicit --ckpt paths so a
+            # fine-tuned checkpoint can't poison the generic cache; an
+            # explicit ahead-of-time conversion IS that cache write, so do it
+            # here (for scanned sources resolve_params cached it already)
+            store.save_msgpack(params, out)
+        print(f"ok {key}: {src} -> {out}")
+        converted += 1
+    print(f"{converted} converted, {skipped} skipped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

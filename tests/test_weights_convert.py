@@ -1,0 +1,48 @@
+"""Ahead-of-time weight conversion: registry coverage + .pth -> .msgpack
+round trip through the scripts/convert_weights.py machinery."""
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from video_features_tpu.weights import store  # noqa: E402
+from video_features_tpu.weights.converters import registry  # noqa: E402
+from tests.torch_oracles import TorchResNet, randomize_bn_stats  # noqa: E402
+
+
+def test_registry_covers_every_hub_key():
+    reg = registry()
+    missing = set(store.HUB_FILENAMES) - set(reg) - {"vggish_pca"}
+    assert not missing, f"no converter for: {sorted(missing)}"
+
+
+def test_convert_script_roundtrip(tmp_path, monkeypatch):
+    oracle = TorchResNet(variant="resnet18").eval()
+    randomize_bn_stats(oracle)
+    ckpt = tmp_path / "resnet18-f37072fd.pth"
+    torch.save(oracle.state_dict(), ckpt)
+
+    env = {"VFT_WEIGHTS_DIR": str(tmp_path / "w"), "JAX_PLATFORMS": "cpu"}
+    script = Path(__file__).resolve().parent.parent / "scripts" / \
+        "convert_weights.py"
+    out = subprocess.run(
+        [sys.executable, str(script), "--model-key", "resnet18",
+         "--ckpt", str(ckpt)],
+        capture_output=True, text=True, env={**__import__("os").environ,
+                                             **env})
+    assert out.returncode == 0, out.stderr
+    msgpack = tmp_path / "w" / "resnet18.msgpack"
+    assert msgpack.exists()
+
+    # the cached tree must round-trip bit-exactly vs direct conversion
+    init_fn, convert_fn = registry()["resnet18"]
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "w"))
+    loaded = store.load_msgpack(init_fn(), msgpack)
+    direct = convert_fn(oracle.state_dict())
+    want = direct["backbone"]["conv1"]["kernel"]
+    got = loaded["backbone"]["conv1"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

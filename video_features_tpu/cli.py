@@ -24,6 +24,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     if "feature_type" not in cli_args:
         raise SystemExit("Usage: main.py feature_type=<family> [key=value ...]")
     args = load_config(cli_args.feature_type, cli_args)
+    if bool(args.get("distributed", False)):
+        # multi-host pod slice: one process per host, launched by the TPU VM
+        # runtime (GKE/gcloud); coordinator/process env comes from the
+        # platform, so the no-arg initialize() is correct. Must run BEFORE
+        # sanity_check: resolve_device calls jax.devices(), which initializes
+        # the backend and would lock process_count() at 1. After this,
+        # jax.process_index()/process_count() drive local_shard_of_list.
+        import jax
+        if not jax.distributed.is_initialized():  # tolerate in-process re-runs
+            jax.distributed.initialize()
     sanity_check(args)
     verbose = args.get("on_extraction", "print") == "print"
     if verbose:

@@ -62,16 +62,10 @@ class ExtractR21D(ClipStackExtractor):
         self.model = r21d_model.R2Plus1D(self.model_name)
         self.head = r21d_model.Classifier()
 
-        def init_fn():
-            import jax
-            v = self.model.init(jax.random.PRNGKey(0),
-                                jnp.zeros((1, 4, 112, 112, 3)))
-            h = self.head.init(jax.random.PRNGKey(1),
-                               jnp.zeros((1, r21d_model.FEATURE_DIM)))
-            return {"backbone": v["params"], "head": h["params"]}
-
         params = store.resolve_params(
-            self.model_name, init_fn, r21d_model.params_from_torch,
+            self.model_name,
+            partial(r21d_model.init_params, self.model_name),
+            r21d_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
         self.head_params = params["head"]

@@ -38,16 +38,10 @@ class ExtractResNet(FrameWiseExtractor):
         self.model = resnet_model.ResNet(self.model_name)
         self.head = resnet_model.Classifier()
 
-        def init_fn():
-            import jax
-            variables = self.model.init(jax.random.PRNGKey(0),
-                                        jnp.zeros((1, 224, 224, 3)))
-            head_vars = self.head.init(jax.random.PRNGKey(1),
-                                       jnp.zeros((1, resnet_model.FEATURE_DIMS[self.model_name])))
-            return {"backbone": variables["params"], "head": head_vars["params"]}
-
         params = store.resolve_params(
-            self.model_name, init_fn, resnet_model.params_from_torch,
+            self.model_name,
+            partial(resnet_model.init_params, self.model_name),
+            resnet_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
         self.head_params = params["head"]

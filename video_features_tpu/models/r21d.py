@@ -128,6 +128,16 @@ class Classifier(nn.Module):
         return nn.Dense(self.num_classes, name="fc")(x)
 
 
+def init_params(variant: str = "r2plus1d_18_16_kinetics") -> Dict[str, Any]:
+    """Random {'backbone', 'head'} trees — the msgpack template shape."""
+    import jax
+    backbone = R2Plus1D(variant).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4, 112, 112, 3)))["params"]
+    head = Classifier().init(
+        jax.random.PRNGKey(1), jnp.zeros((1, FEATURE_DIM)))["params"]
+    return {"backbone": backbone, "head": head}
+
+
 _BN_LEAF = {"weight": "scale", "bias": "bias",
             "running_mean": "mean", "running_var": "var"}
 

@@ -122,6 +122,16 @@ class Classifier(nn.Module):
         return nn.Dense(self.num_classes, name="fc")(x)
 
 
+def init_params(variant: str = "resnet50") -> Dict[str, Any]:
+    """Random {'backbone', 'head'} trees — the msgpack template shape."""
+    import jax
+    backbone = ResNet(variant).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))["params"]
+    head = Classifier().init(
+        jax.random.PRNGKey(1), jnp.zeros((1, FEATURE_DIMS[variant])))["params"]
+    return {"backbone": backbone, "head": head}
+
+
 def params_from_torch(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
     """torchvision resnet state_dict -> {'backbone': ..., 'head': ...} trees."""
     backbone: Dict[str, Any] = {}

@@ -1,0 +1,56 @@
+"""Model-key -> (init_fn, convert_fn) registry for weight transplant.
+
+One place that knows how to build the random template tree and map a torch
+state_dict onto it, for every checkpoint family the framework loads
+(SURVEY §2.5's transplant targets). Used by ``scripts/convert_weights.py``
+for ahead-of-time ``.pth -> .msgpack`` conversion and by anything else that
+needs a converter without constructing a full extractor.
+
+The reference loads weights lazily per extractor from four different kinds
+of source (local .pt/.pth, torchvision/torch.hub downloads, OpenAI CDN
+TorchScript archives, GitHub releases — reference extract_r21d.py:105-113,
+clip_src/clip.py:32-74, vggish_slim.py:122-127). Here every source funnels
+through ``weights.store`` and these converters.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+Converter = Tuple[Callable[[], Any], Callable[[Dict[str, Any]], Any]]
+
+
+def _clip_key_to_name() -> Dict[str, str]:
+    from ..extractors.clip import model_key
+    from ..models.clip import CONFIGS
+    return {model_key(name): name for name in CONFIGS}
+
+
+def registry() -> Dict[str, Converter]:
+    """All convertible model keys (see store.HUB_FILENAMES for the accepted
+    source checkpoint filenames). ``vggish_pca`` is intentionally absent: its
+    params are two plain arrays loaded directly (models/vggish.py
+    load_pca_params), not a flax tree."""
+    from ..models import (clip as clip_m, i3d as i3d_m, pwc as pwc_m,
+                          r21d as r21d_m, raft as raft_m, resnet as resnet_m,
+                          s3d as s3d_m, vggish as vggish_m)
+
+    reg: Dict[str, Converter] = {}
+    for variant in resnet_m.VARIANTS:
+        reg[variant] = (partial(resnet_m.init_params, variant),
+                        resnet_m.params_from_torch)
+    for variant in r21d_m.VARIANTS:
+        reg[variant] = (partial(r21d_m.init_params, variant),
+                        r21d_m.params_from_torch)
+    for key in ("raft_sintel", "raft_kitti"):
+        reg[key] = (raft_m.init_params, raft_m.params_from_torch)
+    for modality in ("rgb", "flow"):
+        reg[f"i3d_{modality}"] = (partial(i3d_m.init_params, modality),
+                                  i3d_m.params_from_torch)
+    reg["s3d_kinetics400"] = (s3d_m.init_params, s3d_m.params_from_torch)
+    reg["pwc_sintel"] = (pwc_m.init_params, pwc_m.params_from_torch)
+    reg["vggish"] = (vggish_m.init_params, vggish_m.params_from_torch)
+    for key, name in _clip_key_to_name().items():
+        reg[key] = (partial(clip_m.init_params, name),
+                    clip_m.params_from_torch)
+    return reg

@@ -65,6 +65,13 @@ def main() -> int:
         init_fn, convert_fn = reg[key]
         src = store.find_checkpoint(key, args.ckpt)
         if src is None:
+            if args.model_key:
+                # a specifically requested conversion must not silently no-op
+                names = ", ".join(
+                    store.HUB_FILENAMES.get(key, ("(model-specific)",)))
+                print(f"error: no source checkpoint found for {key!r} "
+                      f"(accepted filenames: {names})", file=sys.stderr)
+                return 1
             print(f"-- {key}: no source checkpoint found, skipping")
             skipped += 1
             continue
@@ -74,11 +81,10 @@ def main() -> int:
         params = store.resolve_params(key, init_fn, convert_fn,
                                       weights_path=args.ckpt)
         out = store.weights_dir() / f"{key}.msgpack"
-        if args.ckpt:
-            # resolve_params skips caching for explicit --ckpt paths so a
-            # fine-tuned checkpoint can't poison the generic cache; an
-            # explicit ahead-of-time conversion IS that cache write, so do it
-            # here (for scanned sources resolve_params cached it already)
+        if args.ckpt or not out.exists():
+            # explicit --ckpt: resolve_params deliberately skips the cache
+            # write; scanned sources: it caches but swallows OSError — write
+            # here (raising loudly) whenever the cache file is absent
             store.save_msgpack(params, out)
         print(f"ok {key}: {src} -> {out}")
         converted += 1

@@ -28,11 +28,36 @@ REFERENCE_ROOT = "/root/reference"
 SAMPLE_VIDEO = os.path.join(REFERENCE_ROOT, "sample", "v_GGSY1Qvo990.mp4")
 
 
+def _synthesize_sample(path: str) -> str:
+    """A stand-in with the reference sample's nominal properties (355 frames,
+    19.62 fps, 320x240) so the E2E/CLI tests run on hosts without the
+    reference mount (e.g. external CI). Smooth moving gradients: natural-ish
+    low-frequency content that codecs and the yuv420 paths handle like real
+    video, not noise."""
+    import cv2
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),
+                        19.62, (320, 240))
+    assert w.isOpened(), "cv2 VideoWriter cannot encode mp4v"
+    yy, xx = np.mgrid[0:240, 0:320].astype(np.float32)
+    for t in range(355):
+        frame = np.stack([
+            127 + 120 * np.sin(xx / 40 + t / 9),
+            127 + 120 * np.sin(yy / 30 - t / 13),
+            127 + 120 * np.sin((xx + yy) / 50 + t / 7),
+        ], axis=-1)
+        w.write(frame.clip(0, 255).astype(np.uint8))
+    w.release()
+    return path
+
+
 @pytest.fixture(scope="session")
-def sample_video():
-    if not os.path.exists(SAMPLE_VIDEO):
+def sample_video(tmp_path_factory):
+    if os.path.exists(SAMPLE_VIDEO):
+        return SAMPLE_VIDEO
+    if os.environ.get("VFT_NO_SYNTH_SAMPLE"):
         pytest.skip("reference sample video not available")
-    return SAMPLE_VIDEO
+    return _synthesize_sample(
+        str(tmp_path_factory.mktemp("sample") / "v_synth_sample.mp4"))
 
 
 @pytest.fixture(scope="session")

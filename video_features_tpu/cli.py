@@ -32,7 +32,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         # the backend and would lock process_count() at 1. After this,
         # jax.process_index()/process_count() drive local_shard_of_list.
         import jax
-        if not jax.distributed.is_initialized():  # tolerate in-process re-runs
+        # tolerate in-process re-runs; is_initialized is absent on older jax
+        already = getattr(jax.distributed, "is_initialized", lambda: False)
+        if not already():
             jax.distributed.initialize()
     sanity_check(args)
     verbose = args.get("on_extraction", "print") == "print"

@@ -3,11 +3,17 @@
 Run on TPU (no JAX_PLATFORMS override). Used to pick dispatch defaults;
 results recorded in the kernels package docstrings.
 """
+import sys
 import time
+from pathlib import Path
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+# NOT via PYTHONPATH: an env-level path entry loads before sitecustomize's
+# accelerator plugin registration on this host and breaks backend discovery
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from video_features_tpu.kernels.cost_volume import (cost_volume_pallas,
                                                     cost_volume_xla)

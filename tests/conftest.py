@@ -37,7 +37,8 @@ def _synthesize_sample(path: str) -> str:
     import cv2
     w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),
                         19.62, (320, 240))
-    assert w.isOpened(), "cv2 VideoWriter cannot encode mp4v"
+    if not w.isOpened():  # degrade to the old skip, not a hard error
+        pytest.skip("reference sample absent and cv2 cannot encode mp4v")
     yy, xx = np.mgrid[0:240, 0:320].astype(np.float32)
     for t in range(355):
         frame = np.stack([

@@ -32,10 +32,15 @@ def main(argv: Optional[List[str]] = None) -> None:
         # the backend and would lock process_count() at 1. After this,
         # jax.process_index()/process_count() drive local_shard_of_list.
         import jax
-        # tolerate in-process re-runs; is_initialized is absent on older jax
+        # tolerate in-process re-runs; is_initialized is absent on older jax,
+        # where the double-init RuntimeError is caught instead
         already = getattr(jax.distributed, "is_initialized", lambda: False)
-        if not already():
-            jax.distributed.initialize()
+        try:
+            if not already():
+                jax.distributed.initialize()
+        except RuntimeError as e:
+            if "already" not in str(e).lower():
+                raise
     sanity_check(args)
     verbose = args.get("on_extraction", "print") == "print"
     if verbose:

@@ -146,3 +146,45 @@ def test_end_to_end_extraction_from_wav(tmp_path):
     assert ex.output_feat_keys == ["vggish"]
     assert feats["vggish"].shape == (2, 128)
     assert (tmp_path / "out" / "vggish" / "tone_vggish.npy").exists()
+
+
+def test_device_frontend_matches_numpy_dsp():
+    """logmel_examples_jnp (the frontend fused into the jitted forward under
+    frontend=device) must reproduce the numpy/reference DSP."""
+    import jax
+    rng = np.random.default_rng(4)
+    wav = rng.normal(scale=0.1, size=50000)
+    want = audio.waveform_to_examples(wav, 16000)
+    chunks = audio.chunk_waveform(wav, 16000)
+    assert chunks.shape == (want.shape[0], audio.EXAMPLE_CHUNK_SAMPLES)
+    got = np.asarray(jax.jit(audio.logmel_examples_jnp)(chunks))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+    # short input: no complete example -> empty, consistent with the host path
+    assert audio.chunk_waveform(wav[:10000], 16000).shape[0] == \
+        audio.waveform_to_examples(wav[:10000], 16000).shape[0]
+
+
+def test_end_to_end_device_frontend_matches_host(tmp_path):
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.vggish import ExtractVGGish
+
+    rng = np.random.default_rng(5)
+    noise = (0.3 * rng.standard_normal(int(16000 * 2.5)) * 32767) \
+        .clip(-32768, 32767).astype("<i2")
+    wav_path = tmp_path / "noise.wav"
+    _write_wav(wav_path, noise)
+
+    def run(frontend, sub):
+        cfg = load_config("vggish", {
+            "video_paths": str(wav_path), "device": "cpu",
+            "frontend": frontend, "allow_random_weights": True,
+            "output_path": str(tmp_path / sub / "o"),
+            "tmp_path": str(tmp_path / sub / "t"),
+        })
+        sanity_check(cfg)
+        return ExtractVGGish(cfg).extract(str(wav_path))["vggish"]
+
+    host = run("host", "h")
+    device = run("device", "d")
+    assert host.shape == device.shape == (2, 128)
+    np.testing.assert_allclose(device, host, atol=1e-3, rtol=1e-3)

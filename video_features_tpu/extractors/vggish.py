@@ -36,6 +36,16 @@ def _device_forward(model: vggish_model.VGGish, dtype, params, batch):
     return model.apply({"params": params}, x).astype(jnp.float32)
 
 
+def _device_forward_waveform(model: vggish_model.VGGish, dtype, params,
+                             chunks):
+    """(B, 15600) waveform chunks -> (B, 128): the whole mel frontend
+    (framing, periodic-Hann STFT, HTK mel matmul, log — ops/audio.py
+    logmel_examples_jnp) fused into the jitted VGG forward, so the host
+    only mono-mixes/resamples/slices (frontend=device)."""
+    x = audio.logmel_examples_jnp(chunks).astype(dtype)
+    return model.apply({"params": params}, x).astype(jnp.float32)
+
+
 class ExtractVGGish(BaseExtractor):
 
     def __init__(self, args: Config) -> None:
@@ -54,8 +64,13 @@ class ExtractVGGish(BaseExtractor):
             allow_random=bool(args.get("allow_random_weights", False)))
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        self.frontend = args.get("frontend") or "host"
+        if self.frontend not in ("host", "device"):
+            raise NotImplementedError(f"frontend={self.frontend!r}")
+        fwd = (_device_forward_waveform if self.frontend == "device"
+               else _device_forward)
         self.runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype),
+            partial(fwd, self.model, dtype),
             cast_floating(params, dtype),
             mesh=mesh, fixed_batch=self.batch_size)
 
@@ -87,7 +102,10 @@ class ExtractVGGish(BaseExtractor):
                 "(reference extract_vggish.py:42-48)")
 
         data, rate = audio.read_wav(audio_path)
-        examples = audio.waveform_to_examples(data, rate)  # (N, 96, 64, 1)
+        if self.frontend == "device":
+            examples = audio.chunk_waveform(data, rate)  # (N, 15600)
+        else:
+            examples = audio.waveform_to_examples(data, rate)  # (N,96,64,1)
         feats = []
         for start in range(0, len(examples), self.batch_size):
             feats.append(self.runner(examples[start:start + self.batch_size]))

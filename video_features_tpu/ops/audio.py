@@ -175,3 +175,59 @@ def read_wav(path: str) -> Tuple[np.ndarray, int]:
     if n_channels > 1:
         data = data.reshape(-1, n_channels)
     return data, rate
+
+
+# --- device (jnp) frontend -------------------------------------------------
+#
+# The numpy pipeline above is the bit-parity twin of the reference's host DSP
+# (mel_features.py). The device frontend below fuses the same math — framing,
+# periodic-Hann STFT, HTK mel filterbank matmul, log — into the jitted VGG
+# forward, so the (weak) extraction host only mono-mixes, resamples, and
+# slices the waveform. Per-example chunking reproduces whole-waveform
+# processing exactly: example i covers log-mel frames [96i, 96i+96), whose
+# STFTs read samples [96i*160, 96i*160 + 95*160 + 400) — 15600 samples with
+# hop 15360 (SURVEY §7 step 5: "jnp mel frontend").
+
+EXAMPLE_CHUNK_SAMPLES = 95 * 160 + 400  # 15600
+EXAMPLE_HOP_SAMPLES = 96 * 160          # 15360
+
+
+def chunk_waveform(data: np.ndarray, sample_rate: int) -> np.ndarray:
+    """Mono-mix + resample to 16 kHz + slice into per-example waveform
+    chunks: -> (num_examples, 15600) float32. Host-side prep for
+    :func:`logmel_examples_jnp`; for audio holding at least one complete
+    example this yields the same example count as
+    :func:`waveform_to_examples` (the nested STFT/example frame counts
+    reduce to the same floor expression); sub-example audio yields (0, ...)
+    rather than the host path's error on sub-window input."""
+    if data.ndim > 1:
+        data = np.mean(data, axis=1)
+    if sample_rate != SAMPLE_RATE:
+        data = resample(data, sample_rate, SAMPLE_RATE)
+    data = np.asarray(data, dtype=np.float32)
+    if len(data) < EXAMPLE_CHUNK_SAMPLES:
+        return np.zeros((0, EXAMPLE_CHUNK_SAMPLES), dtype=np.float32)
+    # zero-copy strided view; one contiguous copy for the device transfer
+    return np.ascontiguousarray(
+        frame(data, EXAMPLE_CHUNK_SAMPLES, EXAMPLE_HOP_SAMPLES))
+
+
+def logmel_examples_jnp(chunks):
+    """(B, 15600) float32 waveform chunks -> (B, 96, 64, 1) log-mel examples,
+    jittable. Same constants as the numpy path (16 kHz, 25 ms/10 ms STFT,
+    periodic Hann, 512-point rFFT, 64 HTK mel bins 125-7500 Hz, log+0.01)."""
+    import jax.numpy as jnp
+    win = int(round(SAMPLE_RATE * STFT_WINDOW_LENGTH_SECONDS))   # 400
+    hop = int(round(SAMPLE_RATE * STFT_HOP_LENGTH_SECONDS))      # 160
+    fft_length = 512
+    starts = jnp.arange(96) * hop
+    idx = starts[:, None] + jnp.arange(win)[None, :]             # (96, 400)
+    frames = chunks[:, idx]                                      # (B, 96, 400)
+    windowed = frames * jnp.asarray(periodic_hann(win), jnp.float32)
+    mag = jnp.abs(jnp.fft.rfft(windowed, fft_length))            # (B, 96, 257)
+    mel_mat = jnp.asarray(spectrogram_to_mel_matrix(
+        num_mel_bins=NUM_MEL_BINS, num_spectrogram_bins=fft_length // 2 + 1,
+        audio_sample_rate=SAMPLE_RATE, lower_edge_hertz=MEL_MIN_HZ,
+        upper_edge_hertz=MEL_MAX_HZ), jnp.float32)
+    mel = mag @ mel_mat                                          # (B, 96, 64)
+    return jnp.log(mel + LOG_OFFSET)[..., None]

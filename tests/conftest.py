@@ -53,7 +53,10 @@ def _synthesize_sample(path: str) -> str:
 
 @pytest.fixture(scope="session")
 def sample_video(tmp_path_factory):
-    if os.path.exists(SAMPLE_VIDEO):
+    # VFT_FORCE_SYNTH_SAMPLE=1 exercises the synthesis path even when the
+    # reference mount exists (how the fallback itself is validated)
+    force = os.environ.get("VFT_FORCE_SYNTH_SAMPLE", "") not in ("", "0")
+    if os.path.exists(SAMPLE_VIDEO) and not force:
         return SAMPLE_VIDEO
     if os.environ.get("VFT_NO_SYNTH_SAMPLE"):
         pytest.skip("reference sample video not available")

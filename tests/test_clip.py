@@ -6,6 +6,7 @@ import sys
 import types
 
 import numpy as np
+from pathlib import Path
 import pytest
 
 torch = pytest.importorskip("torch")
@@ -196,4 +197,4 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     assert feats["clip"].shape == (37, 512)
     assert feats["timestamps_ms"].shape == (37,)
     out_dir = tmp_path / "out" / "clip" / "ViT-B_32"
-    assert (out_dir / "v_GGSY1Qvo990_clip.npy").exists()
+    assert (out_dir / f"{Path(sample_video).stem}_clip.npy").exists()

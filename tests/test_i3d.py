@@ -4,6 +4,7 @@ import importlib.util
 import os
 
 import numpy as np
+from pathlib import Path
 import pytest
 
 torch = pytest.importorskip("torch")
@@ -147,8 +148,8 @@ def test_end_to_end_two_stream_extraction(sample_video, tmp_path):
     assert feats["flow"].shape == (1, 1024)
     assert feats["timestamps_ms"].shape == (1,)
     out_dir = tmp_path / "out" / "i3d"
-    assert (out_dir / "v_GGSY1Qvo990_rgb.npy").exists()
-    assert (out_dir / "v_GGSY1Qvo990_flow.npy").exists()
+    assert (out_dir / f"{Path(sample_video).stem}_rgb.npy").exists()
+    assert (out_dir / f"{Path(sample_video).stem}_flow.npy").exists()
 
 
 def test_end_to_end_flow_pwc_extraction(sample_video, tmp_path):
@@ -170,4 +171,4 @@ def test_end_to_end_flow_pwc_extraction(sample_video, tmp_path):
     feats = ex._extract(sample_video)
     assert ex.output_feat_keys == ["flow", "fps", "timestamps_ms"]
     assert feats["flow"].shape == (1, 1024)
-    assert (tmp_path / "out" / "i3d" / "v_GGSY1Qvo990_flow.npy").exists()
+    assert (tmp_path / "out" / "i3d" / f"{Path(sample_video).stem}_flow.npy").exists()

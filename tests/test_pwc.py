@@ -8,6 +8,7 @@ import sys
 import types
 
 import numpy as np
+from pathlib import Path
 import pytest
 
 torch = pytest.importorskip("torch")
@@ -152,4 +153,4 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     n, c, h, w = feats["pwc"].shape
     assert (c, h, w) == (2, 84, 112)
     assert n == 18 and len(feats["timestamps_ms"]) == 19
-    assert (tmp_path / "out" / "pwc" / "v_GGSY1Qvo990_pwc.npy").exists()
+    assert (tmp_path / "out" / "pwc" / f"{Path(sample_video).stem}_pwc.npy").exists()

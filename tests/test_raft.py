@@ -3,6 +3,7 @@ from /root/reference as the numerical oracle)."""
 import sys
 
 import numpy as np
+from pathlib import Path
 import pytest
 
 torch = pytest.importorskip("torch")
@@ -108,7 +109,7 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     # => 128x170, padded to /8 inside jit and unpadded back
     n, c, h, w = feats["raft"].shape
     assert (c, h, w) == (2, 128, 170) and n == len(feats["timestamps_ms"]) - 1
-    assert (tmp_path / "out" / "raft" / "v_GGSY1Qvo990_raft.npy").exists()
+    assert (tmp_path / "out" / "raft" / f"{Path(sample_video).stem}_raft.npy").exists()
 
 
 def test_flow_viz_matches_reference():

@@ -82,3 +82,31 @@ def test_short_video_yields_empty(sample_video, tmp_path):
     feats = ex.extract(sample_video)
     # 18 frames < stack 64: trailing partial stack dropped -> no features
     assert feats["r21d"].shape[0] == 0
+
+
+def test_streaming_path_matches_buffered(sample_video, tmp_path):
+    """step >= stack takes the bounded-memory streaming path; it must
+    produce exactly the buffered path's features and window timestamps."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.r21d import ExtractR21D
+    from video_features_tpu.utils.io import VideoSource
+
+    cfg = load_config("r21d", {
+        "video_paths": sample_video, "device": "cpu",
+        "extraction_fps": 4, "stack_size": 8, "step_size": 12,  # gap of 4
+        "clip_batch_size": 2, "allow_random_weights": True,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    sanity_check(cfg)
+    ex = ExtractR21D(cfg)
+    assert ex.step_size >= ex.stack_size
+
+    def make_src():
+        return VideoSource(sample_video, batch_size=1,
+                           fps=ex.extraction_fps,
+                           transform=ex.host_transform)
+
+    streamed = ex._extract_streaming(make_src())["r21d"]
+    buffered = ex._extract_buffered(make_src())["r21d"]
+    assert streamed.shape == buffered.shape and streamed.shape[0] > 0
+    np.testing.assert_allclose(streamed, buffered, atol=1e-6, rtol=1e-6)

@@ -24,7 +24,7 @@ import numpy as np
 
 from ..config import Config
 from ..parallel.mesh import DataParallelApply
-from ..utils.io import VideoSource
+from ..utils.io import Prefetcher, VideoSource
 from ..utils.lists import form_slices
 from .base import BaseExtractor
 
@@ -60,10 +60,19 @@ class ClipStackExtractor(BaseExtractor):
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
                           transform=self.host_transform)
-        # no Prefetcher here: slices may overlap (step < stack), so every
-        # frame is needed before the first forward — there is no compute to
-        # overlap the decode with (reference r21d/s3d read the whole video
-        # up front too, extract_r21d.py:75)
+        if self.step_size >= self.stack_size:
+            # non-overlapping windows (the default for every family): stream
+            # — bounded host memory (one device group of frames, not the
+            # whole video) and decode overlapped with device compute. The
+            # reference reads the whole video up front and warns "could run
+            # out of memory here" (extract_r21d.py:75-77).
+            return self._extract_streaming(src)
+        return self._extract_buffered(src)
+
+    def _extract_buffered(self, src: VideoSource) -> Dict[str, np.ndarray]:
+        """Overlapping windows (step < stack): every frame participates in
+        several windows, so the full frame sequence is materialized and
+        windows are sliced out of it group by group."""
         frames = [f for f, _, _ in src.frames()]
         slices = form_slices(len(frames), self.stack_size, self.step_size)
         vid_feats: List[np.ndarray] = []
@@ -78,6 +87,49 @@ class ClipStackExtractor(BaseExtractor):
                 feats = self.runner(group)  # pads ragged tails to fixed_batch
                 self.maybe_show_pred(feats, window, group)
                 vid_feats.extend(list(feats))
+        return {self.feature_type: np.array(vid_feats)}
+
+    def _extract_streaming(self, src: VideoSource) -> Dict[str, np.ndarray]:
+        """step >= stack: windows are disjoint, so stacks are formed on the
+        fly — frames between windows (step > stack) are dropped as decoded,
+        and the Prefetcher's decode-ahead thread keeps filling while a group
+        is blocked on the device (the runner synchronizes on its D2H copy).
+        Same observable contract as the buffered path: form_slices
+        drop-partial semantics."""
+        gap = self.step_size - self.stack_size
+        vid_feats: List[np.ndarray] = []
+        stacks: List[np.ndarray] = []
+        windows: List = []
+        current: List[np.ndarray] = []
+        start_idx = 0
+
+        def flush():
+            group = np.stack(stacks)
+            feats = self.runner(group)
+            self.maybe_show_pred(feats, list(windows), group)
+            vid_feats.extend(list(feats))
+            stacks.clear()
+            windows.clear()
+
+        until_next = 0  # frames to drop before the next window starts
+        for f, _, idx in Prefetcher(src.frames()):
+            if until_next > 0:
+                until_next -= 1
+                continue
+            if not current:
+                start_idx = idx
+            current.append(f)
+            if len(current) == self.stack_size:
+                stacks.append(np.stack(current))
+                windows.append((start_idx, start_idx + self.stack_size))
+                current.clear()
+                until_next = gap
+                if len(stacks) == self.clip_batch_size:
+                    flush()
+        # trailing partial stack dropped (reference utils/utils.py:59-68);
+        # trailing complete stacks still flush as a ragged (padded) group
+        if stacks:
+            flush()
         return {self.feature_type: np.array(vid_feats)}
 
     def maybe_show_pred(self, feats: np.ndarray, slices,

@@ -110,3 +110,27 @@ def test_streaming_path_matches_buffered(sample_video, tmp_path):
     buffered = ex._extract_buffered(make_src())["r21d"]
     assert streamed.shape == buffered.shape and streamed.shape[0] > 0
     np.testing.assert_allclose(streamed, buffered, atol=1e-6, rtol=1e-6)
+
+
+def test_show_pred_windows_through_streaming(sample_video, tmp_path, capsys):
+    """show_pred flows through the streaming flush with the same (start, end)
+    window labels the buffered path printed (reference extract_r21d.py
+    prints 'At frames (s, e)' per window)."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.r21d import ExtractR21D
+
+    cfg = load_config("r21d", {
+        "video_paths": sample_video, "device": "cpu", "show_pred": True,
+        "extraction_fps": 2, "stack_size": 8, "step_size": 8,
+        "clip_batch_size": 2, "allow_random_weights": True,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    sanity_check(cfg)
+    ex = ExtractR21D(cfg)
+    feats = ex.extract(sample_video)
+    out = capsys.readouterr().out
+    # ~18.1s @2fps = 36-37 frames -> 4 complete 8-frame windows
+    assert feats["r21d"].shape[0] == 4
+    assert out.count("At frames (") == 4  # no duplicated/spurious windows
+    for s in range(0, 32, 8):
+        assert f"At frames ({s}, {s + 8})" in out

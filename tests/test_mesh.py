@@ -45,3 +45,24 @@ def test_bfloat16_precision_casts_params_and_stays_close():
     cos = np.sum(f32 * bf16, axis=1) / (
         np.linalg.norm(f32, axis=1) * np.linalg.norm(bf16, axis=1) + 1e-9)
     assert np.all(cos > 0.99), f"bf16 features diverged: cos={cos}"
+
+
+def test_data_parallel_over_eight_virtual_devices():
+    """The production sharding: batch split over the full 8-device CPU mesh
+    (conftest forces xla_force_host_platform_device_count=8), ragged batch
+    padded to mesh-divisible size and trimmed after execution."""
+    assert len(jax.devices()) == 8, "conftest must force an 8-device mesh"
+    mesh = get_mesh()  # all devices
+    runner = DataParallelApply(lambda p, b: b * p["scale"] + 1.0,
+                               {"scale": np.float32(2.0)}, mesh=mesh)
+    assert runner.n_devices == 8
+    x = np.arange(5 * 3, dtype=np.float32).reshape(5, 3)  # ragged: 5 % 8 != 0
+    out = runner(x)
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out, x * 2.0 + 1.0)
+    # fixed_batch: one executable even for smaller batches
+    runner2 = DataParallelApply(lambda p, b: b * p["scale"],
+                                {"scale": np.float32(3.0)}, mesh=mesh,
+                                fixed_batch=16)
+    np.testing.assert_allclose(runner2(x), x * 3.0)
+    assert runner2.padded_batch_size(5) == 8

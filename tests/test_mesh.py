@@ -60,9 +60,16 @@ def test_data_parallel_over_eight_virtual_devices():
     out = runner(x)
     assert out.shape == (5, 3)
     np.testing.assert_allclose(out, x * 2.0 + 1.0)
-    # fixed_batch: one executable even for smaller batches
-    runner2 = DataParallelApply(lambda p, b: b * p["scale"],
-                                {"scale": np.float32(3.0)}, mesh=mesh,
+    # fixed_batch: smaller batches must pad up to the fixed shape so only
+    # one executable is compiled per video; the traced shape proves it
+    traced_shapes = []
+
+    def fn(p, b):
+        traced_shapes.append(b.shape)
+        return b * p["scale"]
+
+    runner2 = DataParallelApply(fn, {"scale": np.float32(3.0)}, mesh=mesh,
                                 fixed_batch=16)
     np.testing.assert_allclose(runner2(x), x * 3.0)
+    assert traced_shapes == [(16, 3)], traced_shapes
     assert runner2.padded_batch_size(5) == 8

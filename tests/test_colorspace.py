@@ -42,28 +42,34 @@ def test_natural_frame_chroma_loss_is_small(sample_video):
     assert err.mean() < 2.0, f"mean abs err {err.mean()}"
 
 
-@pytest.mark.parametrize("ingest", ["uint8", "yuv420"])
-def test_r21d_ingest_modes_match_float32(sample_video, tmp_path, ingest):
-    """The compressed wire formats must reproduce the float32 path's features
-    (random weights, natural frames): cosine > 0.99."""
+@pytest.mark.parametrize("family,stack,ingest", [
+    ("r21d", 8, "uint8"),
+    ("r21d", 8, "yuv420"),
+    ("s3d", 16, "yuv420"),  # S3D head needs stack >= 16
+])
+def test_ingest_modes_match_float32(sample_video, tmp_path, family, stack,
+                                    ingest):
+    """Every family's compressed wire formats must reproduce the float32
+    path's features (random weights, natural frames): cosine > 0.99."""
     from video_features_tpu.config import load_config, sanity_check
-    from video_features_tpu.extractors.r21d import ExtractR21D
+    from video_features_tpu.registry import get_extractor_cls
 
     def run(mode, sub):
-        cfg = load_config("r21d", {
+        cfg = load_config(family, {
             "video_paths": sample_video, "device": "cpu",
-            "extraction_fps": 2, "stack_size": 8, "step_size": 8,
+            "extraction_fps": 2, "stack_size": stack, "step_size": stack,
             "clip_batch_size": 2, "ingest": mode,
             "allow_random_weights": True,
             "output_path": str(tmp_path / sub / "o"),
             "tmp_path": str(tmp_path / sub / "t"),
         })
         sanity_check(cfg)
-        return ExtractR21D(cfg).extract(sample_video)["r21d"]
+        return get_extractor_cls(family)(cfg).extract(sample_video)[family]
 
     ref = run("float32", "f32")
     got = run(ingest, ingest)
     assert got.shape == ref.shape and ref.shape[0] > 0
     cos = np.sum(ref * got, axis=1) / (
         np.linalg.norm(ref, axis=1) * np.linalg.norm(got, axis=1) + 1e-9)
-    assert np.all(cos > 0.99), f"{ingest} features diverged: cos={cos}"
+    assert np.all(cos > 0.99), \
+        f"{family} {ingest} features diverged: cos={cos}"

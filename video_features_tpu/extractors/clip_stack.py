@@ -57,6 +57,17 @@ class ClipStackExtractor(BaseExtractor):
                 f"ingest={self.ingest!r}; {type(self).__name__} supports "
                 f"{self.supported_ingest}")
 
+    def encode_wire(self, x01: np.ndarray) -> np.ndarray:
+        """[0, 1] float HWC frame -> the configured wire format (the tail of
+        every family's host transform)."""
+        if self.ingest == "float32":
+            return x01
+        from ..ops import colorspace, preprocess as pp
+        u8 = pp.quantize_u8(x01)
+        if self.ingest == "uint8":
+            return u8
+        return colorspace.rgb_to_yuv420(u8)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
                           transform=self.host_transform)

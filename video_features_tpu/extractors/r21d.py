@@ -82,13 +82,7 @@ class ExtractR21D(ClipStackExtractor):
         def transform(rgb: np.ndarray) -> np.ndarray:
             x = rgb.astype(np.float32) / 255.0
             x = pp.bilinear_resize_no_antialias(x, (128, 171))
-            x = pp.center_crop(x, 112)
-            if self.ingest == "float32":
-                return x
-            u8 = pp.quantize_u8(x)
-            if self.ingest == "uint8":
-                return u8
-            return colorspace.rgb_to_yuv420(u8)
+            return self.encode_wire(pp.center_crop(x, 112))
 
         self.host_transform = transform
 

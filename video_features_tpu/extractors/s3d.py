@@ -15,11 +15,20 @@ import numpy as np
 
 from ..config import Config
 from ..models import s3d as s3d_model
+from ..ops import colorspace
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
 from ..weights import store
 from .clip_stack import ClipStackExtractor
+
+
+def _device_forward_yuv420(model: s3d_model.S3D, dtype, features, params,
+                           batch):
+    """Packed-I420 uint8 (B, T, 224*224*3/2) -> features; colorspace
+    conversion on device (ops/colorspace.py), 1.5 bytes/pixel wire."""
+    rgb = colorspace.yuv420_packed_to_rgb(batch, 224, 224) / 255.0
+    return _device_forward(model, dtype, features, params, rgb)
 
 
 def _device_forward(model: s3d_model.S3D, dtype, features, params, batch):
@@ -33,6 +42,8 @@ def _device_forward(model: s3d_model.S3D, dtype, features, params, batch):
 
 
 class ExtractS3D(ClipStackExtractor):
+
+    supported_ingest = ("yuv420", "uint8", "float32")
 
     def __init__(self, args: Config) -> None:
         super().__init__(args, default_stack=64, default_step=64)
@@ -50,11 +61,13 @@ class ExtractS3D(ClipStackExtractor):
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         # cast once for both runners
         params = cast_floating(params, dtype)
+        fwd = (_device_forward_yuv420 if self.ingest == "yuv420"
+               else _device_forward)
         self.runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype, True),
+            partial(fwd, self.model, dtype, True),
             params, mesh=mesh, fixed_batch=self.clip_batch_size)
         self._logits_runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype, False),
+            partial(fwd, self.model, dtype, False),
             params, mesh=mesh, fixed_batch=self.clip_batch_size) \
             if self.show_pred else None
 
@@ -62,8 +75,7 @@ class ExtractS3D(ClipStackExtractor):
             x = rgb.astype(np.float32) / 255.0
             scale = 224.0 / min(x.shape[0], x.shape[1])
             x = pp.bilinear_resize_by_scale(x, scale)
-            x = pp.center_crop(x, 224)
-            return pp.quantize_u8(x) if self.ingest == "uint8" else x
+            return self.encode_wire(pp.center_crop(x, 224))
 
         self.host_transform = transform
 

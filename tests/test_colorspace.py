@@ -73,3 +73,31 @@ def test_ingest_modes_match_float32(sample_video, tmp_path, family, stack,
         np.linalg.norm(ref, axis=1) * np.linalg.norm(got, axis=1) + 1e-9)
     assert np.all(cos > 0.99), \
         f"{family} {ingest} features diverged: cos={cos}"
+
+
+@pytest.mark.parametrize("family", ["resnet", "clip"])
+def test_framewise_yuv420_ingest_matches_uint8(sample_video, tmp_path,
+                                               family):
+    """Frame-wise families: packed-I420 wire reproduces the uint8 (default,
+    lossless) path's features on natural frames: cosine > 0.99."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    def run(mode, sub):
+        cfg = load_config(family, {
+            "video_paths": sample_video, "device": "cpu",
+            "extraction_fps": 1, "batch_size": 8, "ingest": mode,
+            "allow_random_weights": True,
+            "output_path": str(tmp_path / sub / "o"),
+            "tmp_path": str(tmp_path / sub / "t"),
+        })
+        sanity_check(cfg)
+        return get_extractor_cls(family)(cfg).extract(sample_video)[family]
+
+    ref = run("uint8", "u8")
+    got = run("yuv420", "yuv")
+    assert got.shape == ref.shape and ref.shape[0] > 0
+    cos = np.sum(ref * got, axis=1) / (
+        np.linalg.norm(ref, axis=1) * np.linalg.norm(got, axis=1) + 1e-9)
+    assert np.all(cos > 0.99), \
+        f"{family} yuv420 features diverged: cos={cos}"

@@ -38,6 +38,17 @@ class BaseExtractor:
         self.show_pred = bool(args.get("show_pred", False))
         self.args = args
 
+    def _resolve_ingest(self, args: Config, default: str) -> str:
+        """Validate the host->device wire format against the subclass's
+        ``supported_ingest`` (shared by the clip-stack and frame-wise
+        pipelines — see their class docs for the format semantics)."""
+        ingest = args.get("ingest") or default
+        if ingest not in getattr(self, "supported_ingest", ()):
+            raise NotImplementedError(
+                f"ingest={ingest!r}; {type(self).__name__} supports "
+                f"{self.supported_ingest}")
+        return ingest
+
     # -- lifecycle ---------------------------------------------------------
     def _extract(self, video_path: str) -> Optional[Dict[str, np.ndarray]]:
         if sinks.is_already_exist(self.on_extraction, self.output_path,

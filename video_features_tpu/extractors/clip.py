@@ -44,6 +44,15 @@ def _encode_image(model: clip_model.CLIP, dtype, params, batch_u8):
                        method="encode_image").astype(jnp.float32)
 
 
+def _encode_image_yuv420(model: clip_model.CLIP, dtype, size, params,
+                         packed):
+    """Packed-I420 uint8 (B, R*R*3/2) -> (B,embed); colorspace conversion on
+    device (ops/colorspace.py, [0,255] floats) into the shared forward."""
+    from ..ops import colorspace
+    rgb = colorspace.yuv420_packed_to_rgb(packed, size, size)
+    return _encode_image(model, dtype, params, rgb)
+
+
 class ExtractCLIP(FrameWiseExtractor):
 
     def __init__(self, args: Config) -> None:
@@ -75,16 +84,23 @@ class ExtractCLIP(FrameWiseExtractor):
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
-        self.runner = DataParallelApply(
-            partial(_encode_image, self.model, dtype),
-            cast_floating(params, dtype),
-            mesh=mesh, fixed_batch=self.batch_size)
-
         input_size = self.cfg.image_resolution
+        if self.ingest == "yuv420":
+            if input_size % 2:
+                raise NotImplementedError(
+                    f"ingest=yuv420 needs an even input resolution (I420 "
+                    f"chroma subsampling); {self.model_name} uses "
+                    f"{input_size}")
+            fwd = partial(_encode_image_yuv420, self.model, dtype, input_size)
+        else:
+            fwd = partial(_encode_image, self.model, dtype)
+        self.runner = DataParallelApply(
+            fwd, cast_floating(params, dtype),
+            mesh=mesh, fixed_batch=self.batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, input_size, interpolation="bicubic")
-            return pp.center_crop(out, input_size)
+            return self.encode_wire_u8(pp.center_crop(out, input_size))
 
         self.host_transform = transform
 

@@ -50,12 +50,8 @@ class ClipStackExtractor(BaseExtractor):
         self.output_feat_keys = [self.feature_type]
         self.host_transform: Optional[Callable] = None
         self.runner: Optional[DataParallelApply] = None
-        self.ingest = args.get("ingest") or (
-            "uint8" if self.precision == "bfloat16" else "float32")
-        if self.ingest not in self.supported_ingest:
-            raise NotImplementedError(
-                f"ingest={self.ingest!r}; {type(self).__name__} supports "
-                f"{self.supported_ingest}")
+        self.ingest = self._resolve_ingest(
+            args, "uint8" if self.precision == "bfloat16" else "float32")
 
     def encode_wire(self, x01: np.ndarray) -> np.ndarray:
         """[0, 1] float HWC frame -> the configured wire format (the tail of

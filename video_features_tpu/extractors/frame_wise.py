@@ -35,6 +35,11 @@ class FrameWiseExtractor(BaseExtractor):
       - ``self.maybe_show_pred(feats np.ndarray)``
     """
 
+    #: wire formats: uint8 is the default AND the parity path (PIL resize
+    #: outputs uint8, so nothing is lost); 'yuv420' opts into packed I420 at
+    #: 1.5 bytes/pixel with colorspace conversion on device (H2D-bound hosts)
+    supported_ingest = ("uint8", "yuv420")
+
     def __init__(self, args: Config) -> None:
         super().__init__(args)
         self.model_name = args.get("model_name")
@@ -44,6 +49,14 @@ class FrameWiseExtractor(BaseExtractor):
         self.output_feat_keys = [self.feature_type, "fps", "timestamps_ms"]
         self.host_transform: Optional[Callable] = None
         self.runner: Optional[DataParallelApply] = None
+        self.ingest = self._resolve_ingest(args, "uint8")
+
+    def encode_wire_u8(self, u8: np.ndarray) -> np.ndarray:
+        """uint8 HWC frame -> the configured wire format (transform tail)."""
+        if self.ingest == "uint8":
+            return u8
+        from ..ops import colorspace
+        return colorspace.rgb_to_yuv420(u8)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         video = VideoSource(

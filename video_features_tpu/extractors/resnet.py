@@ -29,6 +29,15 @@ def _device_forward(model: resnet_model.ResNet, dtype, params, batch_u8):
     return model.apply({"params": params}, x).astype(jnp.float32)
 
 
+def _device_forward_yuv420(model: resnet_model.ResNet, dtype, params,
+                           packed):
+    """Packed-I420 uint8 (B, 224*224*3/2) -> (B,D); colorspace conversion on
+    device (ops/colorspace.py, [0,255] floats) into the shared forward."""
+    from ..ops import colorspace
+    rgb = colorspace.yuv420_packed_to_rgb(packed, 224, 224)
+    return _device_forward(model, dtype, params, rgb)
+
+
 class ExtractResNet(FrameWiseExtractor):
 
     def __init__(self, args: Config) -> None:
@@ -48,14 +57,16 @@ class ExtractResNet(FrameWiseExtractor):
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        fwd = (_device_forward_yuv420 if self.ingest == "yuv420"
+               else _device_forward)
         self.runner = DataParallelApply(
-            partial(_device_forward, self.model, dtype),
+            partial(fwd, self.model, dtype),
             cast_floating(params["backbone"], dtype),
             mesh=mesh, fixed_batch=self.batch_size)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, 256, interpolation="bilinear")
-            return pp.center_crop(out, 224)
+            return self.encode_wire_u8(pp.center_crop(out, 224))
 
         self.host_transform = transform
 

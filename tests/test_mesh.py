@@ -73,3 +73,56 @@ def test_data_parallel_over_eight_virtual_devices():
     np.testing.assert_allclose(runner2(x), x * 3.0)
     assert traced_shapes == [(16, 3)], traced_shapes
     assert runner2.padded_batch_size(5) == 8
+
+
+def test_feature_stream_matches_sync_path():
+    """FeatureStream (async dispatch, the no-show_pred extract path) must
+    return exactly what the per-batch synchronous calls return, in submit
+    order, including ragged tails and explicit n_valid."""
+    mesh = get_mesh()
+    runner = DataParallelApply(lambda p, b: b * p["scale"],
+                               {"scale": np.float32(2.0)}, mesh=mesh,
+                               fixed_batch=8)
+    rng = np.random.default_rng(0)
+    batches = [rng.normal(size=(n, 3)).astype(np.float32)
+               for n in (8, 8, 5)]  # ragged tail
+    stream = runner.stream(depth=2)  # depth < #batches: forces mid-loop pops
+    for b in batches:
+        stream.submit(b)
+    got = stream.finish()
+    want = [runner(b) for b in batches]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w)
+    # a drained stream is reusable and empty
+    assert stream.finish() == []
+    stream.submit(batches[0], n_valid=4)
+    (g,) = stream.finish()
+    np.testing.assert_allclose(g, batches[0][:4] * 2.0)
+
+
+def test_feature_stream_depth_zero_is_synchronous():
+    mesh = get_mesh(n_devices=1)
+    runner = DataParallelApply(lambda p, b: b + p["one"],
+                               {"one": np.float32(1.0)}, mesh=mesh)
+    stream = runner.stream(depth=0)
+    x = np.zeros((2, 2), np.float32)
+    stream.submit(x)
+    assert len(stream._inflight) == 0  # materialized immediately
+    np.testing.assert_allclose(stream.finish()[0], x + 1.0)
+
+
+def test_feature_stream_callback_fires_in_order_with_ctx():
+    """The show_pred path: depth=0 + callback must fire per submit, in
+    order, with valid rows only and the submit's ctx."""
+    mesh = get_mesh(n_devices=1)
+    runner = DataParallelApply(lambda p, b: b * 2.0, {}, mesh=mesh)
+    seen = []
+    stream = runner.stream(depth=0,
+                           callback=lambda feats, ctx: seen.append(
+                               (feats.shape[0], ctx)))
+    stream.submit(np.ones((3, 2), np.float32), ctx="a")
+    assert seen == [(3, "a")]  # fired before submit returned (synchronous)
+    stream.submit(np.ones((2, 2), np.float32), n_valid=1, ctx="b")
+    assert seen == [(3, "a"), (1, "b")]
+    assert len(stream.finish()) == 2

@@ -38,6 +38,15 @@ class BaseExtractor:
         self.show_pred = bool(args.get("show_pred", False))
         self.args = args
 
+    def feature_stream(self, runner, depth: int = 4, on_result=None):
+        """Async dispatch stream over ``runner`` (parallel/mesh.py
+        FeatureStream). When show_pred needs per-batch host values, the
+        stream degrades to synchronous (depth=0) with ``on_result`` fired
+        per batch — one code path either way."""
+        if self.show_pred and on_result is not None:
+            return runner.stream(depth=0, callback=on_result)
+        return runner.stream(depth=depth)
+
     def _resolve_ingest(self, args: Config, default: str) -> str:
         """Validate the host->device wire format against the subclass's
         ``supported_ingest`` (shared by the clip-stack and frame-wise

@@ -83,6 +83,7 @@ class ClipStackExtractor(BaseExtractor):
         frames = [f for f, _, _ in src.frames()]
         slices = form_slices(len(frames), self.stack_size, self.step_size)
         vid_feats: List[np.ndarray] = []
+        stream = self._make_stream()
         if slices:
             all_frames = np.stack(frames)  # (T, *frame_wire_shape)
             for i in range(0, len(slices), self.clip_batch_size):
@@ -91,16 +92,19 @@ class ClipStackExtractor(BaseExtractor):
                 # multiply peak host memory by stack_size/step_size
                 window = slices[i:i + self.clip_batch_size]
                 group = np.stack([all_frames[s:e] for s, e in window])
-                feats = self.runner(group)  # pads ragged tails to fixed_batch
-                self.maybe_show_pred(feats, window, group)
-                vid_feats.extend(list(feats))
+                # async dispatch (parallel/mesh.py FeatureStream): window
+                # assembly of group k+1 overlaps device compute of k
+                stream.submit(group, ctx=(window, group))
+        for feats in stream.finish():
+            vid_feats.extend(list(feats))
         return {self.feature_type: np.array(vid_feats)}
 
     def _extract_streaming(self, src: VideoSource) -> Dict[str, np.ndarray]:
         """step >= stack: windows are disjoint, so stacks are formed on the
-        fly — frames between windows (step > stack) are dropped as decoded,
-        and the Prefetcher's decode-ahead thread keeps filling while a group
-        is blocked on the device (the runner synchronizes on its D2H copy).
+        fly — frames between windows (step > stack) are dropped as decoded;
+        groups are dispatched asynchronously (submit returns immediately;
+        only a depth-overflow pop or the final finish() blocks on D2H), so
+        the Prefetcher's decode-ahead thread and the device overlap freely.
         Same observable contract as the buffered path: form_slices
         drop-partial semantics."""
         gap = self.step_size - self.stack_size
@@ -109,12 +113,11 @@ class ClipStackExtractor(BaseExtractor):
         windows: List = []
         current: List[np.ndarray] = []
         start_idx = 0
+        stream = self._make_stream()
 
         def flush():
             group = np.stack(stacks)
-            feats = self.runner(group)
-            self.maybe_show_pred(feats, list(windows), group)
-            vid_feats.extend(list(feats))
+            stream.submit(group, ctx=(list(windows), group))
             stacks.clear()
             windows.clear()
 
@@ -137,7 +140,14 @@ class ClipStackExtractor(BaseExtractor):
         # trailing complete stacks still flush as a ragged (padded) group
         if stacks:
             flush()
+        for feats in stream.finish():
+            vid_feats.extend(list(feats))
         return {self.feature_type: np.array(vid_feats)}
+
+    def _make_stream(self):
+        return self.feature_stream(
+            self.runner,
+            on_result=lambda feats, ctx: self.maybe_show_pred(feats, *ctx))
 
     def maybe_show_pred(self, feats: np.ndarray, slices,
                         group: Optional[np.ndarray] = None) -> None:

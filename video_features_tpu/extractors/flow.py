@@ -69,6 +69,11 @@ class OpticalFlowExtractor(BaseExtractor):
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         first = True
+        # async dispatch, shallow window: each pending output is a full
+        # (B, H, W, 2) float field, so at most 2 wait on-device at once
+        stream = self.feature_stream(
+            self.runner, depth=2,
+            on_result=lambda flows, arr: self.maybe_show_pred(flows, arr))
         # decode-ahead: the next batch decodes while this one is on-device
         for batch, ts, _ in Prefetcher(video):
             if len(batch) < 2:
@@ -79,11 +84,11 @@ class OpticalFlowExtractor(BaseExtractor):
                 continue
             arr = np.stack(batch)  # (n, H, W, 3) uint8
             pairs = np.stack([arr[:-1], arr[1:]], axis=1)
-            flows = self.runner(pairs)  # (n-1, H, W, 2) float32
-            self.maybe_show_pred(flows, arr)
-            vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
+            stream.submit(pairs, ctx=arr)
             timestamps_ms.extend(ts if first else ts[1:])
             first = False
+        for flows in stream.finish():  # (n-1, H, W, 2) float32 per batch
+            vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
         return {
             self.feature_type: np.array(vid_feats),
             "fps": np.array(video.fps),

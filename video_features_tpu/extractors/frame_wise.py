@@ -68,13 +68,17 @@ class FrameWiseExtractor(BaseExtractor):
         )
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
-        # decode-ahead: the next batch decodes while this one is on-device
+        # decode-ahead: the next batch decodes while this one is on-device;
+        # batches are dispatched asynchronously and materialized at the end
+        # (no per-batch D2H stall) unless show_pred needs per-batch values
+        stream = self.feature_stream(
+            self.runner, on_result=lambda feats, ctx: self.maybe_show_pred(feats))
         for batch, times, _ in Prefetcher(video):
-            arr = np.stack(batch)  # runner pads ragged tails to fixed_batch
-            feats = self.runner(arr)
-            self.maybe_show_pred(feats)
-            vid_feats.extend(list(feats))
+            # runner pads ragged tails to fixed_batch
+            stream.submit(np.stack(batch))
             timestamps_ms.extend(times)
+        for feats in stream.finish():
+            vid_feats.extend(list(feats))
         return {
             self.feature_type: np.array(vid_feats),
             "fps": np.array(video.fps),

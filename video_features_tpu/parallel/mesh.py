@@ -121,23 +121,99 @@ class DataParallelApply:
         n = self.n_devices
         return ((batch_size + n - 1) // n) * n
 
-    def __call__(self, batch_np: np.ndarray, n_valid: Optional[int] = None
-                 ) -> np.ndarray:
-        """Run a (possibly ragged) batch; returns only the valid rows.
-
-        Pads up to ``fixed_batch`` (if set — one executable per video) and
-        then to a mesh-divisible size, drops padded rows after execution.
-        """
-        from ..utils.profiling import profiler
-        n = batch_np.shape[0] if n_valid is None else n_valid
+    def _pad(self, batch_np: np.ndarray) -> np.ndarray:
+        """Host-side pad up to ``fixed_batch`` (if set — one executable per
+        video) and then to a mesh-divisible size."""
         target = max(batch_np.shape[0], self.fixed_batch or 0)
         full = self.padded_batch_size(target)
         if full != batch_np.shape[0]:
             pad_width = [(0, full - batch_np.shape[0])] + \
                         [(0, 0)] * (batch_np.ndim - 1)
             batch_np = np.pad(batch_np, pad_width)
+        return batch_np
+
+    def dispatch(self, batch_np: np.ndarray) -> jnp.ndarray:
+        """Pad + enqueue the jitted forward; returns the device array
+        WITHOUT synchronizing (JAX dispatch is async — the host thread is
+        free as soon as the computation is enqueued). Padded rows are NOT
+        dropped; callers track validity (see :class:`FeatureStream`)."""
+        return self._fn(self.params, self._pad(batch_np))
+
+    def __call__(self, batch_np: np.ndarray, n_valid: Optional[int] = None
+                 ) -> np.ndarray:
+        """Run a (possibly ragged) batch; returns only the valid rows."""
+        from ..utils.profiling import profiler
+        n = batch_np.shape[0] if n_valid is None else n_valid
+        padded = self._pad(batch_np)  # host copy kept out of the timed stage
         # np.asarray blocks on the device->host copy, so this stage is true
         # H2D + forward + D2H wall time
         with profiler.stage("forward"):
-            out = self._fn(self.params, batch_np)
-            return np.asarray(out)[:n]
+            return np.asarray(self._fn(self.params, padded))[:n]
+
+    def stream(self, depth: int = 4,
+               callback: Optional[Callable[[np.ndarray, Any], None]] = None
+               ) -> "FeatureStream":
+        return FeatureStream(self, depth=depth, callback=callback)
+
+
+class FeatureStream:
+    """Ordered async pipeline over a :class:`DataParallelApply`.
+
+    The synchronous ``runner(batch)`` call blocks on the device->host copy of
+    every batch, serializing host work with the device (and, on a tunneled
+    dev chip, paying a round trip per batch). ``submit`` instead just
+    enqueues the jitted forward — decode of batch k+1, device compute of
+    batch k, and the D2H of batch k-``depth`` all overlap — and ``finish``
+    materializes every result in submit order.
+
+    ``depth`` bounds how many un-materialized outputs may live on the device
+    at once — exactly: the oldest output is drained *before* a new batch is
+    dispatched when at capacity (matters for flow families, whose per-batch
+    output is a full (B, H, W, 2) field). 0 means synchronous: each submit
+    materializes its result before returning.
+
+    ``callback(feats, ctx)`` (optional) fires at materialization time, in
+    submit order, with the valid rows and the ``ctx`` passed to ``submit`` —
+    how show_pred paths get per-batch host values (with depth=0 to keep the
+    reference's print-as-you-go behavior) without a second code path in the
+    extractors.
+    """
+
+    def __init__(self, runner: DataParallelApply, depth: int = 4,
+                 callback: Optional[Callable[[np.ndarray, Any], None]] = None):
+        from collections import deque
+        self.runner = runner
+        self.depth = max(int(depth), 0)
+        self.callback = callback
+        self._inflight: Any = deque()  # (device_array, n_valid, ctx)
+        self._done: List[np.ndarray] = []
+
+    def submit(self, batch_np: np.ndarray, n_valid: Optional[int] = None,
+               ctx: Any = None) -> None:
+        n = batch_np.shape[0] if n_valid is None else n_valid
+        if self.callback is None:
+            ctx = None  # don't pin (possibly large) host batches in the queue
+        while self._inflight and len(self._inflight) >= self.depth:
+            self._pop()  # drain BEFORE dispatching: bound holds during _pop
+        self._inflight.append((self.runner.dispatch(batch_np), n, ctx))
+        if self.depth == 0:
+            self._pop()
+
+    def _pop(self) -> None:
+        from ..utils.profiling import profiler
+        out, n, ctx = self._inflight.popleft()
+        # the blocking host copy: under the profiler this stage is the
+        # pipeline's *stall* time on the device, not raw device time — by
+        # design everything else already happened in the background
+        with profiler.stage("forward"):
+            feats = np.asarray(out)[:n]
+        if self.callback is not None:
+            self.callback(feats, ctx)
+        self._done.append(feats)
+
+    def finish(self) -> List[np.ndarray]:
+        """Materialize all pending results; returns them in submit order."""
+        while self._inflight:
+            self._pop()
+        done, self._done = self._done, []
+        return done

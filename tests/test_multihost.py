@@ -70,3 +70,34 @@ def test_two_concurrent_workers_one_output_dir(sample_video, tmp_path):
     for f in files:
         arr = np.load(f)  # a torn write would raise here
         assert np.isfinite(np.asarray(arr, dtype=np.float64)).all()
+
+
+def test_video_workers_threaded_pipeline_matches_serial(sample_video,
+                                                        tmp_path, monkeypatch):
+    """video_workers=2: the host sides of two videos run on concurrent
+    threads feeding one device queue (cli.py). Outputs must be file-for-file
+    identical to the serial loop."""
+    import shutil
+    from video_features_tpu.cli import main as cli_main
+
+    second = tmp_path / "v_worker_copy.mp4"
+    shutil.copy(sample_video, second)
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+
+    def run(out, workers):
+        cli_main([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=2", "allow_random_weights=true",
+            f"video_workers={workers}", "on_extraction=save_numpy",
+            f"output_path={out}", f"tmp_path={tmp_path / 'tmp'}",
+            f"video_paths=[{sample_video},{second}]",
+        ])
+        return {p.name: np.load(p)
+                for p in sorted((out / "resnet" / "resnet18").glob("*.npy"))}
+
+    serial = run(tmp_path / "serial", 1)
+    threaded = run(tmp_path / "threaded", 2)
+    assert serial.keys() == threaded.keys() and len(serial) == 6
+    for name in serial:
+        np.testing.assert_array_equal(serial[name], threaded[name],
+                                      err_msg=name)

@@ -63,9 +63,35 @@ def main(argv: Optional[List[str]] = None) -> None:
     profiler.reset()  # the profiler is process-global; in-process re-runs
     # (library use, tests) must not inherit the previous run's stats
 
+    workers = int(args.get("video_workers") or 1)
     with TraceCapture(args.get("profile_trace_dir")):
-        for video_path in tqdm(video_paths):
-            safe_extract(extractor._extract, video_path)
+        if workers <= 1:
+            for video_path in tqdm(video_paths):
+                safe_extract(extractor._extract, video_path)
+        else:
+            # Cross-video pipelining: the host side (cv2 decode + PIL
+            # transforms) of up to `video_workers` videos runs on concurrent
+            # threads feeding the single device queue — while one video's
+            # batch computes, another video decodes. cv2/PIL release the GIL;
+            # each video's FeatureStream keeps its own submit order, and
+            # per-video error isolation (safe_extract) is unchanged. The
+            # reference's only cross-video parallelism was whole extra
+            # processes per GPU (reference README.md:70-84).
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix="vft-video") as pool:
+                try:
+                    done = pool.map(
+                        lambda p: safe_extract(extractor._extract, p),
+                        video_paths)
+                    for _ in tqdm(done, total=len(video_paths)):
+                        pass
+                except KeyboardInterrupt:
+                    # drop the not-yet-started videos; in-flight ones finish
+                    # (their partial outputs stay valid thanks to atomic
+                    # writes + resume-on-restart)
+                    pool.shutdown(cancel_futures=True)
+                    raise
 
     if profiler.enabled:
         print(profiler.summary(f"profile: {args.feature_type} x "

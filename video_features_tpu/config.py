@@ -203,6 +203,16 @@ def sanity_check(args: Config) -> None:
     if args.get("show_pred") and args.feature_type == "vggish":
         print("Showing class predictions is not implemented for VGGish")
 
+    if int(args.get("video_workers") or 1) > 1 and (
+            args.get("on_extraction", "print") == "print"
+            or args.get("show_pred")):
+        # concurrent videos would interleave their stdout dumps line-by-line
+        print("WARNING: video_workers > 1 with on_extraction=print or "
+              "show_pred would interleave per-video output; forcing "
+              "video_workers=1. Use save_numpy/save_pickle for pipelined "
+              "multi-video extraction.")
+        args.video_workers = 1
+
     if args.feature_type == "i3d" and args.get("stack_size") is not None:
         assert args.stack_size >= 10, (
             "I3D model does not support inputs shorter than 10 timestamps. "

@@ -126,3 +126,22 @@ def test_feature_stream_callback_fires_in_order_with_ctx():
     stream.submit(np.ones((2, 2), np.float32), n_valid=1, ctx="b")
     assert seen == [(3, "a"), (1, "b")]
     assert len(stream.finish()) == 2
+
+
+def test_dispatch_chain_pads_on_device_and_trims():
+    """Chained runners (the i3d flow->i3d handoff): dispatch() keeps padded
+    rows — callers must slice back to valid rows — and _pad of a device
+    array must stay on device (jnp.pad), not round-trip through np.pad."""
+    mesh = get_mesh()  # 8 virtual devices
+    r1 = DataParallelApply(lambda p, b: b * 2.0, {}, mesh=mesh,
+                           fixed_batch=10)
+    x = np.arange(10 * 2, dtype=np.float32).reshape(10, 2)
+    dev = r1.dispatch(x)
+    assert dev.shape[0] == 16  # 10 padded up to the 8-device multiple
+    stacked = jnp.stack([dev[:10], dev[:10]])  # lazy on-device slice+stack
+    r2 = DataParallelApply(lambda p, b: b.sum(axis=-1), {}, mesh=mesh,
+                           fixed_batch=8)
+    padded = r2._pad(stacked)
+    assert isinstance(padded, jax.Array), "ragged device batch left the device"
+    out = r2(stacked, n_valid=2)
+    np.testing.assert_allclose(out, np.tile((x * 2.0).sum(-1), (2, 1)))

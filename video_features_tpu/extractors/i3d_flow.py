@@ -112,10 +112,29 @@ class FlowStream:
                 i3d_params, mesh=mesh, fixed_batch=parent.clip_batch_size)
 
     def run(self, group: np.ndarray, stack_base: int) -> np.ndarray:
-        """group: (G, stack+1, H, W, 3) uint8 resized frames -> (G, 1024)."""
-        quant = [self.pair_runner(np.stack([g[:-1], g[1:]], axis=1))
-                 for g in group]
-        flow_in = np.stack(quant)  # (G, T, 224, 224, 2) float32
+        """group: (G, stack+1, H, W, 3) uint8 resized frames -> (G, 1024).
+
+        The flow->i3d handoff stays on device: each stack's pair batch is
+        *dispatched* (async, no D2H) and the quantized crops — the largest
+        intermediate, (G, T, 224, 224, 2) float32 — are stacked as device
+        arrays and fed straight to the I3D runner. Only the (G, 1024)
+        features cross back to the host (the reference round-trips every
+        stack through host tensors between its two models)."""
+        flow_in = self._device_flow(group)
         out = self.runner(flow_in)
         self.parent.maybe_show_pred("flow", flow_in, stack_base)
         return out
+
+    def dispatch(self, group: np.ndarray):
+        """Async twin of :meth:`run` (no show_pred): the whole flow->i3d
+        chain enqueued, un-materialized (G_padded, 1024) device array out."""
+        return self.runner.dispatch(self._device_flow(group))
+
+    def _device_flow(self, group: np.ndarray):
+        t = group.shape[1] - 1  # T pairs from T+1 frames
+        # dispatch() keeps padded rows (stack_size may not divide the mesh),
+        # so slice back to the T valid pairs — a lazy on-device slice
+        quant = [self.pair_runner.dispatch(np.stack([g[:-1], g[1:]],
+                                                    axis=1))[:t]
+                 for g in group]
+        return jnp.stack(quant)

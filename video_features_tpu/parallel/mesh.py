@@ -106,6 +106,7 @@ class DataParallelApply:
         batch_sharding = NamedSharding(self.mesh, P(data_axis))
         replicated = NamedSharding(self.mesh, P())
         self.params = jax.device_put(params, replicated)
+        self._batch_sharding = batch_sharding
         self._fn = jax.jit(
             apply_fn,
             in_shardings=(replicated, batch_sharding),
@@ -122,14 +123,22 @@ class DataParallelApply:
         return ((batch_size + n - 1) // n) * n
 
     def _pad(self, batch_np: np.ndarray) -> np.ndarray:
-        """Host-side pad up to ``fixed_batch`` (if set — one executable per
-        video) and then to a mesh-divisible size."""
+        """Pad up to ``fixed_batch`` (if set — one executable per video) and
+        then to a mesh-divisible size. Device arrays (chained runners, e.g.
+        the i3d flow->i3d handoff) pad with jnp — async, on device — so a
+        ragged group never forces a D2H round trip of the intermediate."""
         target = max(batch_np.shape[0], self.fixed_batch or 0)
         full = self.padded_batch_size(target)
         if full != batch_np.shape[0]:
             pad_width = [(0, full - batch_np.shape[0])] + \
                         [(0, 0)] * (batch_np.ndim - 1)
-            batch_np = np.pad(batch_np, pad_width)
+            xp = jnp if isinstance(batch_np, jax.Array) else np
+            batch_np = xp.pad(batch_np, pad_width)
+        if isinstance(batch_np, jax.Array):
+            # chained-runner inputs carry the *producer's* sharding; the jit
+            # below requires the batch sharding exactly, so reshard on device
+            # (async; a no-op when shardings already match)
+            batch_np = jax.device_put(batch_np, self._batch_sharding)
         return batch_np
 
     def dispatch(self, batch_np: np.ndarray) -> jnp.ndarray:

@@ -86,3 +86,23 @@ def test_merge_deep():
     b = Config({"x": {"y": 5}})
     m = merge(a, b)
     assert m.x.y == 5 and m.x.z == 2 and m.k == 0
+
+
+def test_compilation_cache_knob(monkeypatch, tmp_path):
+    """compilation_cache_dir: 'auto' resolves env var then the home cache;
+    null/empty disables (cli.py _enable_compilation_cache)."""
+    from video_features_tpu.cli import _enable_compilation_cache
+
+    calls = {}
+    import jax
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: calls.__setitem__(k, v))
+
+    _enable_compilation_cache(dict(compilation_cache_dir=None))
+    _enable_compilation_cache(dict(compilation_cache_dir=False))  # yaml 'false'
+    assert not calls
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "env"))
+    _enable_compilation_cache(dict(compilation_cache_dir="auto"))
+    assert calls["jax_compilation_cache_dir"] == str(tmp_path / "env")
+    _enable_compilation_cache(dict(compilation_cache_dir=str(tmp_path / "x")))
+    assert calls["jax_compilation_cache_dir"] == str(tmp_path / "x")

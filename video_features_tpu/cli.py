@@ -18,6 +18,33 @@ from .utils.lists import form_list_from_user_input
 from .utils.sinks import safe_extract
 
 
+def _enable_compilation_cache(args) -> None:
+    """Persistent XLA compilation cache, on by default.
+
+    The serial-reference analog of this cost doesn't exist (torch eager has
+    no compile step), but here every (family, resolution, batch) executable
+    costs tens of seconds of XLA compile on first use — paying it once per
+    *machine* instead of once per *run* matters for the CLI's
+    one-process-per-invocation lifecycle. ``compilation_cache_dir=null``
+    disables; the default honors JAX's own env var when set."""
+    import os
+    cache_dir = args.get("compilation_cache_dir", "auto")
+    # CLI values go through yaml.safe_load: `false`/`off`/`no` arrive as
+    # bool False, `true` as bool True
+    if cache_dir in (None, "null", "false", "") or cache_dir is False:
+        return
+    if cache_dir == "auto" or cache_dir is True:
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "video_features_tpu", "xla_cache"))
+    import jax
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    # small executables are worth caching too: the CLI compiles few, reuses
+    # them across runs, and the default 1s min-compile-time would skip them
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     cli_args = parse_dotlist(argv)
@@ -42,6 +69,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             if "already" not in str(e).lower():
                 raise
     sanity_check(args)
+    _enable_compilation_cache(args)
     verbose = args.get("on_extraction", "print") == "print"
     if verbose:
         print(args.to_yaml())

@@ -101,3 +101,68 @@ def test_video_workers_threaded_pipeline_matches_serial(sample_video,
     for name in serial:
         np.testing.assert_array_equal(serial[name], threaded[name],
                                       err_msg=name)
+
+
+def test_sigterm_graceful_preemption(sample_video, tmp_path):
+    """Preemptible-worker contract (cli.py): on SIGTERM the worker finishes
+    the in-flight video, drops the rest, prints the run summary, and exits
+    143; a restarted worker resumes via the idempotent skip."""
+    import shutil
+    import signal as _signal
+    import time as _time
+
+    # enough videos that plenty of work remains when the signal lands right
+    # after the first output file (fine-grained 50ms poll below)
+    vids = []
+    for i in range(8):
+        v = tmp_path / f"v_pre_{i}.mp4"
+        shutil.copy(sample_video, v)
+        vids.append(str(v))
+    out = tmp_path / "out"
+    repo = Path(__file__).resolve().parent.parent
+    cmd = [sys.executable, "main.py", "feature_type=resnet",
+           "model_name=resnet18", "device=cpu", "batch_size=8",
+           "extraction_fps=2", "allow_random_weights=true",
+           "on_extraction=save_numpy", f"output_path={out}",
+           f"tmp_path={tmp_path / 'tmp'}",
+           f"video_paths=[{','.join(vids)}]"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VFT_WEIGHTS_DIR": str(tmp_path / "weights")}
+    # log to a file, not a PIPE: nobody drains a PIPE while we poll for
+    # output files, and a full pipe buffer would deadlock the worker
+    log_path = tmp_path / "worker.log"
+    with open(log_path, "wb") as log:
+        proc = subprocess.Popen(cmd, cwd=repo, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        try:
+            # wait for the first feature file, then preempt
+            deadline = _time.time() + 300
+            while _time.time() < deadline:
+                if list(out.rglob("*_resnet.npy")):
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(log_path.read_text()[-2000:])
+                _time.sleep(0.05)
+            else:
+                raise AssertionError("no output appeared before deadline: "
+                                     + log_path.read_text()[-2000:])
+            proc.send_signal(_signal.SIGTERM)
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    text = log_path.read_text()
+    assert proc.returncode == 143, text[-2000:]
+    assert "SIGTERM: finishing in-flight" in text
+    assert "failed" in text  # the run summary printed
+    done_before = {p.name for p in out.rglob("*_resnet.npy")}
+    assert 0 < len(done_before) <= 8
+    # every written output is complete & loadable (atomic writes)
+    for p in out.rglob("*.npy"):
+        np.load(p)
+    # restart: remaining videos extract, finished ones skip
+    r = subprocess.run(cmd, cwd=repo, env=env, capture_output=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert len(list(out.rglob("*_resnet.npy"))) == 8

@@ -48,5 +48,7 @@ def test_safe_extract_isolates_errors():
         calls.append(path)
         raise RuntimeError("decode failed")
 
-    assert sinks.safe_extract(bad, "v.mp4") is False
+    assert sinks.safe_extract(bad, "v.mp4") == "error"
     assert calls == ["v.mp4"]
+    assert sinks.safe_extract(lambda p: {"x": 1}, "v.mp4") == "done"
+    assert sinks.safe_extract(lambda p: None, "v.mp4") == "skipped"

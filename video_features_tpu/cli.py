@@ -8,6 +8,7 @@ the list to this host's deterministic shard (parallel/mesh.py).
 from __future__ import annotations
 
 import sys
+import time
 from typing import List, Optional
 
 from tqdm import tqdm
@@ -32,6 +33,13 @@ def _enable_compilation_cache(args) -> None:
     # CLI values go through yaml.safe_load: `false`/`off`/`no` arrive as
     # bool False, `true` as bool True
     if cache_dir in (None, "null", "false", "") or cache_dir is False:
+        return
+    if args.get("device") == "cpu" and cache_dir in ("auto", True):
+        # XLA:CPU executables bake in the compiling host's CPU features; on a
+        # heterogeneous fleet a cache hit from a different machine risks
+        # SIGILL (XLA warns loudly and may crash). TPU executables have no
+        # such hazard and are where compiles are expensive — so 'auto' only
+        # persists for TPU runs; an explicit dir still opts CPU runs in.
         return
     if cache_dir == "auto" or cache_dir is True:
         cache_dir = os.environ.get(
@@ -91,39 +99,82 @@ def main(argv: Optional[List[str]] = None) -> None:
     profiler.reset()  # the profiler is process-global; in-process re-runs
     # (library use, tests) must not inherit the previous run's stats
 
-    workers = int(args.get("video_workers") or 1)
-    with TraceCapture(args.get("profile_trace_dir")):
-        if workers <= 1:
-            for video_path in tqdm(video_paths):
-                safe_extract(extractor._extract, video_path)
-        else:
-            # Cross-video pipelining: the host side (cv2 decode + PIL
-            # transforms) of up to `video_workers` videos runs on concurrent
-            # threads feeding the single device queue — while one video's
-            # batch computes, another video decodes. cv2/PIL release the GIL;
-            # each video's FeatureStream keeps its own submit order, and
-            # per-video error isolation (safe_extract) is unchanged. The
-            # reference's only cross-video parallelism was whole extra
-            # processes per GPU (reference README.md:70-84).
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=workers,
-                                    thread_name_prefix="vft-video") as pool:
-                try:
-                    done = pool.map(
-                        lambda p: safe_extract(extractor._extract, p),
-                        video_paths)
-                    for _ in tqdm(done, total=len(video_paths)):
-                        pass
-                except KeyboardInterrupt:
-                    # drop the not-yet-started videos; in-flight ones finish
-                    # (their partial outputs stay valid thanks to atomic
-                    # writes + resume-on-restart)
-                    pool.shutdown(cancel_futures=True)
-                    raise
+    # Graceful preemption: preemptible TPU workers get SIGTERM with a grace
+    # window. Finish the in-flight video(s) — atomic writes + the idempotent
+    # skip make a restarted worker resume exactly where this one stopped —
+    # drop the rest, and exit 143. (The reference's only preemption story
+    # was re-running the whole shuffled list, README.md:75-77.)
+    import signal
+    import threading
+    stop = threading.Event()
+    in_main = threading.current_thread() is threading.main_thread()
+    prev_handler = None
+    if in_main:
+        def _on_sigterm(signo, frame):
+            print("SIGTERM: finishing in-flight video(s), dropping the rest")
+            stop.set()
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
+    workers = int(args.get("video_workers") or 1)
+    tally = {"done": 0, "skipped": 0, "error": 0}
+    tally_lock = threading.Lock()
+    t_run = time.perf_counter()
+
+    def run_one(video_path: str) -> None:
+        if stop.is_set():
+            return
+        status = safe_extract(extractor._extract, video_path)
+        with tally_lock:
+            tally[status] += 1
+
+    try:
+        with TraceCapture(args.get("profile_trace_dir")):
+            if workers <= 1:
+                for video_path in tqdm(video_paths):
+                    if stop.is_set():
+                        break
+                    run_one(video_path)
+            else:
+                # Cross-video pipelining: the host side (cv2 decode + PIL
+                # transforms) of up to `video_workers` videos runs on
+                # concurrent threads feeding the single device queue — while
+                # one video's batch computes, another video decodes. cv2/PIL
+                # release the GIL; each video's FeatureStream keeps its own
+                # submit order, and per-video error isolation (safe_extract)
+                # is unchanged. The reference's only cross-video parallelism
+                # was whole extra processes per GPU (reference README.md:
+                # 70-84).
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="vft-video") as pool:
+                    try:
+                        done = pool.map(run_one, video_paths)
+                        for _ in tqdm(done, total=len(video_paths)):
+                            pass
+                    except KeyboardInterrupt:
+                        # drop the not-yet-started videos; in-flight ones
+                        # finish (their outputs stay valid thanks to atomic
+                        # writes + resume-on-restart)
+                        pool.shutdown(cancel_futures=True)
+                        raise
+    finally:
+        # prev_handler is None when a C-level handler was installed before
+        # us; signal.signal() can't restore those (TypeError)
+        if in_main and prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
+
+    elapsed = time.perf_counter() - t_run
+    n_run = sum(tally.values())
+    print(f"{n_run}/{len(video_paths)} videos in {elapsed:.1f}s: "
+          f"{tally['done']} extracted, {tally['skipped']} already done, "
+          f"{tally['error']} failed"
+          + (f" ({tally['done'] / elapsed:.2f} videos/s)"
+             if tally["done"] else ""))
     if profiler.enabled:
         print(profiler.summary(f"profile: {args.feature_type} x "
                                f"{len(video_paths)} videos"))
+    if stop.is_set():
+        raise SystemExit(143)  # conventional SIGTERM exit; resume = re-run
     if verbose:
         print(f"Yay! Done! The results are in {args.output_path}")
 

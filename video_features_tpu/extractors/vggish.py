@@ -106,9 +106,10 @@ class ExtractVGGish(BaseExtractor):
             examples = audio.chunk_waveform(data, rate)  # (N, 15600)
         else:
             examples = audio.waveform_to_examples(data, rate)  # (N,96,64,1)
-        feats = []
+        stream = self.feature_stream(self.runner)  # vggish has no show_pred
         for start in range(0, len(examples), self.batch_size):
-            feats.append(self.runner(examples[start:start + self.batch_size]))
+            stream.submit(examples[start:start + self.batch_size])
+        feats = stream.finish()
         vggish_stack = (np.concatenate(feats) if feats
                         else np.zeros((0, vggish_model.EMBEDDING_SIZE),
                                       dtype=np.float32))

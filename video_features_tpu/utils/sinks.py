@@ -136,18 +136,20 @@ def action_on_extraction(feats_dict: Dict[str, np.ndarray],
             writer(fpath, value)
 
 
-def safe_extract(extract_fn, video_path: str) -> bool:
+def safe_extract(extract_fn, video_path: str) -> str:
     """Run one video; any failure prints a traceback and is non-fatal.
 
     The per-video error isolation of reference base_extractor.py:40-53
-    (KeyboardInterrupt re-raised). Returns True on success.
+    (KeyboardInterrupt re-raised). Returns ``'done'``, ``'skipped'`` (the
+    idempotent already-exists path returned without extracting), or
+    ``'error'`` — the CLI's run summary tallies these.
     """
     try:
-        extract_fn(video_path)
-        return True
+        result = extract_fn(video_path)
+        return "done" if result is not None else "skipped"
     except KeyboardInterrupt:
         raise
     except Exception:
         print(f"An error occurred extracting features for: {video_path}")
         traceback.print_exc()
-        return False
+        return "error"

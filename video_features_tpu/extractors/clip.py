@@ -24,7 +24,8 @@ import numpy as np
 from ..config import Config
 from ..models import clip as clip_model
 from ..ops import preprocess as pp
-from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
+from ..parallel.mesh import (DataParallelApply, TP_RULES_TRANSFORMER,
+                             cast_floating, get_mesh, param_specs_by_rules)
 from ..utils.labels import KINETICS_CLASS_PATH, show_predictions_on_dataset
 from ..weights import store
 from .frame_wise import FrameWiseExtractor
@@ -83,7 +84,28 @@ class ExtractCLIP(FrameWiseExtractor):
             raise NotImplementedError(f"Model {self.model_name} not found")
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        # model_parallel=N: 2-D (data, model) mesh with Megatron-style
+        # sharding of the transformer blocks and the RN* attention-pool head
+        # (parallel/mesh.py TP_RULES_TRANSFORMER; conv trunks stay
+        # replicated) — for the large ViT checkpoints where weight residency
+        # or per-batch latency matters more than pure data-parallel
+        # throughput. N must divide the device count.
+        mp = int(args.get("model_parallel") or 1)
+        param_specs = None
+        if mp > 1:
+            # honor device=cpu: enumerate only the CPU backend's devices
+            # (never touching the TPU), same contract as the mp==1 branch
+            backend = "cpu" if self.device == "cpu" else None
+            n = len(jax.devices(backend) if backend else jax.devices())
+            if n % mp:
+                raise ValueError(f"model_parallel={mp} must divide the "
+                                 f"device count ({n})")
+            mesh = get_mesh(axis_names=("data", "model"),
+                            shape=(n // mp, mp), backend=backend)
+            param_specs = param_specs_by_rules(params, TP_RULES_TRANSFORMER)
+        else:
+            mesh = (get_mesh(n_devices=1) if self.device == "cpu"
+                    else get_mesh())
         input_size = self.cfg.image_resolution
         if self.ingest == "yuv420":
             if input_size % 2:
@@ -96,7 +118,8 @@ class ExtractCLIP(FrameWiseExtractor):
             fwd = partial(_encode_image, self.model, dtype)
         self.runner = DataParallelApply(
             fwd, cast_floating(params, dtype),
-            mesh=mesh, fixed_batch=self.batch_size)
+            mesh=mesh, fixed_batch=self.batch_size,
+            param_specs=param_specs)
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, input_size, interpolation="bicubic")

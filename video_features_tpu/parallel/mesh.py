@@ -32,9 +32,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def get_mesh(n_devices: Optional[int] = None,
              axis_names: Tuple[str, ...] = ("data",),
-             shape: Optional[Tuple[int, ...]] = None) -> Mesh:
-    """Build a mesh over the first ``n_devices`` local devices (default: all)."""
-    devs = jax.devices()
+             shape: Optional[Tuple[int, ...]] = None,
+             backend: Optional[str] = None) -> Mesh:
+    """Build a mesh over the first ``n_devices`` local devices (default: all).
+
+    ``backend`` pins the platform (e.g. ``"cpu"``) — an explicit
+    ``device=cpu`` run must never enumerate (and thereby claim) the TPU.
+    """
+    devs = jax.devices(backend) if backend else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     if shape is None:
@@ -69,6 +74,48 @@ def local_shard_of_list(items: Sequence[str], host_id: Optional[int] = None,
     return out
 
 
+#: Megatron-style tensor-parallel rules for the transformer blocks used by
+#: CLIP (models/clip.py param tree): column-parallel qkv/mlp-in (shard the
+#: output feature dim + bias), row-parallel out/mlp-out (shard the input
+#: dim, replicate bias — XLA inserts the psum). First match wins; everything
+#: unmatched stays replicated. GSPMD propagates the internal activation
+#: shardings and collectives from these param annotations alone.
+TP_RULES_TRANSFORMER: Tuple[Tuple[str, int], ...] = (
+    (r"attn/(q|k|v)_proj/kernel$", 1),
+    (r"attn/(q|k|v)_proj/bias$", 0),
+    (r"mlp_c_fc/kernel$", 1),
+    (r"mlp_c_fc/bias$", 0),
+    (r"attn/out_proj/kernel$", 0),
+    (r"mlp_c_proj/kernel$", 0),
+    # ModifiedResNet's AttentionPool2d head (the RN* checkpoints' largest
+    # single weight block); the conv trunk stays replicated
+    (r"attnpool/(q|k|v)_proj/kernel$", 1),
+    (r"attnpool/(q|k|v)_proj/bias$", 0),
+    (r"attnpool/c_proj/kernel$", 0),
+)
+
+
+def param_specs_by_rules(params: Any,
+                         rules: Sequence[Tuple[str, int]],
+                         model_axis: str = "model") -> Any:
+    """PartitionSpec tree from path-regex rules: ``(pattern, dim)`` shards
+    that tensor dimension over ``model_axis`` for the first matching rule;
+    unmatched leaves are replicated. This is how a plain (metadata-free)
+    flax param tree gets tensor-parallel layouts without rewriting modules."""
+    import re
+
+    def spec(path, x):
+        p = "/".join(str(getattr(k, "key", k)) for k in path)
+        for pat, dim in rules:
+            if re.search(pat, p):
+                s: List[Optional[str]] = [None] * np.ndim(x)
+                s[dim] = model_axis
+                return P(*s)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def cast_floating(tree: Any, dtype) -> Any:
     """Cast every floating-point leaf of a param tree to ``dtype``.
 
@@ -99,17 +146,26 @@ class DataParallelApply:
                  params: Any,
                  mesh: Optional[Mesh] = None,
                  data_axis: str = "data",
-                 fixed_batch: Optional[int] = None):
+                 fixed_batch: Optional[int] = None,
+                 param_specs: Any = None):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.data_axis = data_axis
         self.fixed_batch = fixed_batch
         batch_sharding = NamedSharding(self.mesh, P(data_axis))
-        replicated = NamedSharding(self.mesh, P())
-        self.params = jax.device_put(params, replicated)
+        if param_specs is None:
+            param_shardings = NamedSharding(self.mesh, P())  # replicated
+        else:
+            # tensor parallelism: per-leaf PartitionSpecs (e.g. from
+            # param_specs_by_rules) shard the weights over the 'model' axis;
+            # GSPMD derives the activation shardings + collectives
+            param_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        self.params = jax.device_put(params, param_shardings)
         self._batch_sharding = batch_sharding
         self._fn = jax.jit(
             apply_fn,
-            in_shardings=(replicated, batch_sharding),
+            in_shardings=(param_shardings, batch_sharding),
             out_shardings=batch_sharding,
         )
 
@@ -118,8 +174,10 @@ class DataParallelApply:
         return int(np.prod(self.mesh.devices.shape))
 
     def padded_batch_size(self, batch_size: int) -> int:
-        """Smallest multiple of the mesh size >= batch_size."""
-        n = self.n_devices
+        """Smallest multiple of the *data-axis* size >= batch_size (on a 2-D
+        (data, model) mesh the batch only splits over 'data'; padding to the
+        total device count would over-pad by the model-parallel factor)."""
+        n = int(self.mesh.shape[self.data_axis])
         return ((batch_size + n - 1) // n) * n
 
     def _pad(self, batch_np: np.ndarray) -> np.ndarray:

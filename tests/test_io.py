@@ -118,3 +118,51 @@ def test_form_slices_drops_partial_tail():
                                         (60, 75), (75, 90)]
     assert form_slices(10, 4, 2) == [(0, 4), (2, 6), (4, 8), (6, 10)]
     assert form_slices(3, 4, 2) == []
+
+
+def test_device_resize_matches_pil(rng):
+    """ops/preprocess.py device_resize: the PIL-coefficient matmul resize
+    must stay within 2 LSB of Pillow for both filters, up- and downscale."""
+    from video_features_tpu.ops.preprocess import (device_resize,
+                                                   pil_resize,
+                                                   pil_resize_matrix)
+    for (ih, iw, oh, ow) in ((240, 320, 256, 341), (240, 320, 112, 149),
+                             (120, 90, 224, 168)):
+        img = rng.integers(0, 255, size=(ih, iw, 3), dtype=np.uint8)
+        for interp in ("bilinear", "bicubic"):
+            ref = pil_resize(img, (oh, ow), interpolation=interp)
+            rmat = pil_resize_matrix(ih, oh, interp)
+            cmat = pil_resize_matrix(iw, ow, interp)
+            got = np.asarray(device_resize(img[None], rmat, cmat))[0]
+            d = np.abs(got - ref.astype(np.float64)).max()
+            assert d <= 2.0, (interp, (ih, iw, oh, ow), d)
+
+
+def test_frame_wise_device_resize_matches_host(sample_video, tmp_path,
+                                               monkeypatch):
+    """resize=device end to end (resnet): features must match the host-PIL
+    path within the 2-LSB input quantization difference."""
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+
+    def feats(resize):
+        args = load_config("resnet", parse_dotlist([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=2", "allow_random_weights=true",
+            f"resize={resize}", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}", f"video_paths={sample_video}"]))
+        sanity_check(args)
+        return get_extractor_cls("resnet")(args).extract(sample_video)
+
+    host = feats("host")
+    dev = feats("device")
+    np.testing.assert_array_equal(host["timestamps_ms"],
+                                  dev["timestamps_ms"])
+    a, b = host["resnet"], dev["resnet"]
+    assert a.shape == b.shape
+    cos = np.sum(a * b, axis=1) / (np.linalg.norm(a, axis=1)
+                                   * np.linalg.norm(b, axis=1) + 1e-9)
+    assert np.all(cos > 0.999), cos.min()

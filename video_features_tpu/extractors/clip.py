@@ -107,6 +107,7 @@ class ExtractCLIP(FrameWiseExtractor):
             mesh = (get_mesh(n_devices=1) if self.device == "cpu"
                     else get_mesh())
         input_size = self.cfg.image_resolution
+        uint8_fwd = partial(_encode_image, self.model, dtype)
         if self.ingest == "yuv420":
             if input_size % 2:
                 raise NotImplementedError(
@@ -115,11 +116,21 @@ class ExtractCLIP(FrameWiseExtractor):
                     f"{input_size}")
             fwd = partial(_encode_image_yuv420, self.model, dtype, input_size)
         else:
-            fwd = partial(_encode_image, self.model, dtype)
+            fwd = uint8_fwd
         self.runner = DataParallelApply(
             fwd, cast_floating(params, dtype),
-            mesh=mesh, fixed_batch=self.batch_size,
+            mesh=mesh, fixed_batch=self.batch_size, param_specs=param_specs)
+        # per-resolution device-resize runners reuse the committed device
+        # arrays: one (possibly TP-sharded) weight copy in HBM total
+        committed = self.runner.params
+        self.runner_builder = lambda f: DataParallelApply(
+            f, committed, mesh=mesh, fixed_batch=self.batch_size,
             param_specs=param_specs)
+        # resize=device (frame_wise.py): Resize(R) bicubic + CenterCrop R on
+        # the MXU, host ships raw frames
+        self.resize_spec = (input_size, "bicubic", True)
+        self.crop_size = input_size
+        self.base_fwd = uint8_fwd
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, input_size, interpolation="bicubic")

@@ -57,12 +57,23 @@ class ExtractResNet(FrameWiseExtractor):
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
-        fwd = (_device_forward_yuv420 if self.ingest == "yuv420"
-               else _device_forward)
+        uint8_fwd = partial(_device_forward, self.model, dtype)
+        fwd = (partial(_device_forward_yuv420, self.model, dtype)
+               if self.ingest == "yuv420" else uint8_fwd)
         self.runner = DataParallelApply(
-            partial(fwd, self.model, dtype),
-            cast_floating(params["backbone"], dtype),
+            fwd, cast_floating(params["backbone"], dtype),
             mesh=mesh, fixed_batch=self.batch_size)
+        # per-resolution device-resize runners reuse the committed device
+        # arrays: one replicated weight copy in HBM no matter how many
+        # source resolutions a run sees
+        committed = self.runner.params
+        self.runner_builder = lambda f: DataParallelApply(
+            f, committed, mesh=mesh, fixed_batch=self.batch_size)
+        # resize=device (frame_wise.py): Resize(256) bilinear + CenterCrop
+        # 224 on the MXU, host ships raw frames
+        self.resize_spec = (256, "bilinear", True)
+        self.crop_size = 224
+        self.base_fwd = uint8_fwd
 
         def transform(rgb: np.ndarray) -> np.ndarray:
             out = pp.pil_resize(rgb, 256, interpolation="bilinear")

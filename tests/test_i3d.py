@@ -172,3 +172,34 @@ def test_end_to_end_flow_pwc_extraction(sample_video, tmp_path):
     assert ex.output_feat_keys == ["flow", "fps", "timestamps_ms"]
     assert feats["flow"].shape == (1, 1024)
     assert (tmp_path / "out" / "i3d" / f"{Path(sample_video).stem}_flow.npy").exists()
+
+
+def test_i3d_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
+    """resize=device (both streams: resize fused into rgb-I3D and the
+    RAFT pair chain) must match the host-PIL path within the 2-LSB input
+    quantization difference."""
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+
+    def feats(resize):
+        args = load_config("i3d", parse_dotlist([
+            "feature_type=i3d", "device=cpu", "stack_size=10",
+            "step_size=10", "extraction_fps=2", "allow_random_weights=true",
+            f"resize={resize}", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}", f"video_paths={sample_video}"]))
+        sanity_check(args)
+        return get_extractor_cls("i3d")(args).extract(sample_video)
+
+    host = feats("host")
+    dev = feats("device")
+    np.testing.assert_array_equal(host["timestamps_ms"],
+                                  dev["timestamps_ms"])
+    for stream in ("rgb", "flow"):
+        a, b = host[stream], dev[stream]
+        assert a.shape == b.shape and a.shape[1] == 1024
+        cos = np.sum(a * b, axis=1) / (np.linalg.norm(a, axis=1)
+                                       * np.linalg.norm(b, axis=1) + 1e-9)
+        assert np.all(cos > 0.99), (stream, cos.min())

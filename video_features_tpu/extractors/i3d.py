@@ -63,6 +63,7 @@ class ExtractI3D(BaseExtractor):
         self.output_feat_keys = self.streams + ["fps", "timestamps_ms"]
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        self._dtype = dtype
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
         self.model = i3d_model.I3D(num_classes=400)
         self.runners: Dict[str, DataParallelApply] = {}
@@ -92,7 +93,63 @@ class ExtractI3D(BaseExtractor):
             # (extract_i3d.py:41-46; PILToTensor+ToFloat only change layout)
             return pp.pil_resize(rgb, self.min_side_size)
 
-        self.host_transform = transform
+        # resize=device: the 256-edge PIL filtering (~1.3 ms/frame/core) is
+        # the host bottleneck for this family; run it as coefficient matmuls
+        # in front of both streams instead (ops/preprocess.py device_resize)
+        # and ship raw decoded frames. show_pred needs per-stack host frames
+        # at the resized geometry, so it keeps the host path.
+        self.resize_mode = args.get("resize") or "host"
+        if self.resize_mode not in ("host", "device"):
+            raise NotImplementedError(f"resize={self.resize_mode!r}: "
+                                      "expected 'host' or 'device'")
+        if self.resize_mode == "device" and self.show_pred:
+            print("WARNING: resize=device is unsupported with show_pred; "
+                  "using resize=host")
+            self.resize_mode = "host"
+        self._res_runners: Dict = {}
+        import threading
+        self._res_lock = threading.Lock()  # video_workers share this cache
+        self.host_transform = None if self.resize_mode == "device" \
+            else transform
+
+    def _runners_for(self, in_h: int, in_w: int):
+        """Per-source-resolution (resize_runner, rgb_runner) pair. The
+        resize runner resizes a whole raw (G, T+1, h, w, 3) uint8 group
+        ONCE on device (uint8 out, exactly the host path's PIL-uint8
+        semantics); both streams then consume the resized device array —
+        raw frames cross H2D once and each frame is resized once. Committed
+        backbone params are shared with the base runners (one HBM copy);
+        bounded cache, one entry per source resolution."""
+        key = (in_h, in_w)
+        with self._res_lock:
+            got = self._res_runners.get(key)
+            if got is not None:
+                return got
+            mesh = (self.runners.get("rgb")
+                    or self._flow_stream.pair_runner).mesh
+            ow, oh = pp.resize_edge_size(in_w, in_h, self.min_side_size)
+            resize_frames = pp.make_device_resizer(in_h, in_w, oh, ow)
+            resize_runner = DataParallelApply(
+                lambda params, g_u8: resize_frames(g_u8), {},
+                mesh=mesh, fixed_batch=self.clip_batch_size)
+            rgb_runner = None
+            if "rgb" in self.streams:
+                base = self.runners["rgb"]
+                c = self.central_crop_size
+                ci, cj = (oh - c) // 2, (ow - c) // 2  # TensorCenterCrop
+
+                def rgb_fwd(params, resized_u8):  # (G, T+1, oh, ow, 3)
+                    x = resized_u8[:, :-1, ci:ci + c, cj:cj + c, :]
+                    return _i3d_forward(self.model, self._dtype, True,
+                                        params, x)
+
+                rgb_runner = DataParallelApply(
+                    rgb_fwd, base.params, mesh=base.mesh,
+                    fixed_batch=self.clip_batch_size)
+            if len(self._res_runners) >= 8:  # bound executable count
+                self._res_runners.pop(next(iter(self._res_runners)), None)
+            got = self._res_runners[key] = (resize_runner, rgb_runner)
+            return got
 
     def _init_flow_stream(self, args, mesh, dtype, allow_random) -> None:
         from . import i3d_flow
@@ -107,6 +164,7 @@ class ExtractI3D(BaseExtractor):
         timestamps_ms: List[float] = []
         feats: Dict[str, List] = {s: [] for s in self.streams}
         stacks_done = 0
+        res_runners = None  # (rgb_runner, pair_runner) under resize=device
 
         def flush():
             nonlocal stacks_done
@@ -123,8 +181,17 @@ class ExtractI3D(BaseExtractor):
                 # both streams dispatched before either synchronizes: the
                 # (cheap) rgb forward executes while the host assembles the
                 # flow chain, and only the (G, 1024) features come back
-                pending = [(s, self.dispatch_stream(s, group))
-                           for s in self.streams]
+                if res_runners is not None:
+                    # resize=device: raw group crosses H2D once, resized
+                    # once, and the uint8 result feeds both streams
+                    resized = res_runners[0].dispatch(group)[:len(group)]
+                    pending = [
+                        (s, res_runners[1].dispatch(resized) if s == "rgb"
+                         else self._flow_stream.dispatch_resized(resized))
+                        for s in self.streams]
+                else:
+                    pending = [(s, self.dispatch_stream(s, group))
+                               for s in self.streams]
                 from ..utils.profiling import profiler
                 for stream, dev in pending:
                     with profiler.stage("forward"):  # the blocking D2H sync
@@ -135,6 +202,10 @@ class ExtractI3D(BaseExtractor):
         # decode-ahead roughly one stack while the previous stack is on-device
         for frame, _, idx in Prefetcher(src.frames(),
                                         depth=max(2, self.stack_size)):
+            if res_runners is None and self.resize_mode == "device":
+                # resize matrices from the first *decoded* frame's shape
+                # (container metadata may disagree, e.g. rotation tags)
+                res_runners = self._runners_for(*frame.shape[:2])
             frames.append(frame)
             if len(frames) - 1 == self.stack_size:
                 stacks.append(np.stack(frames))

@@ -74,8 +74,9 @@ class FlowStream:
                 raft_model.params_from_torch,
                 weights_path=args.get("flow_model_weights_path"),
                 allow_random=allow_random)
+            self._quant_fn = partial(_raft_quantized_flow, flow_model, crop)
             self.pair_runner = DataParallelApply(
-                partial(_raft_quantized_flow, flow_model, crop), flow_params,
+                self._quant_fn, flow_params,
                 mesh=mesh, fixed_batch=parent.stack_size)
         elif parent.flow_type == "pwc":
             # PWC path: no padder — the net resizes to /64 internally and
@@ -87,8 +88,9 @@ class FlowStream:
                 pwc_model.params_from_torch,
                 weights_path=args.get("flow_model_weights_path"),
                 allow_random=allow_random)
+            self._quant_fn = partial(_pwc_quantized_flow, flow_model, crop)
             self.pair_runner = DataParallelApply(
-                partial(_pwc_quantized_flow, flow_model, crop), flow_params,
+                self._quant_fn, flow_params,
                 mesh=mesh, fixed_batch=parent.stack_size)
         else:
             raise NotImplementedError(
@@ -130,11 +132,22 @@ class FlowStream:
         chain enqueued, un-materialized (G_padded, 1024) device array out."""
         return self.runner.dispatch(self._device_flow(group))
 
-    def _device_flow(self, group: np.ndarray):
+    def dispatch_resized(self, resized_u8):
+        """resize=device path: same chain but over the already-on-device
+        resized (G, T+1, oh, ow, 3) uint8 group — pairs are formed by lazy
+        device slices, so nothing extra crosses H2D and no frame is resized
+        twice. The base pair runner works unchanged (it accepts uint8/float
+        frames at the resized geometry)."""
+        return self.runner.dispatch(self._device_flow(resized_u8))
+
+    def _device_flow(self, group):
         t = group.shape[1] - 1  # T pairs from T+1 frames
         # dispatch() keeps padded rows (stack_size may not divide the mesh),
-        # so slice back to the T valid pairs — a lazy on-device slice
-        quant = [self.pair_runner.dispatch(np.stack([g[:-1], g[1:]],
+        # so slice back to the T valid pairs — a lazy on-device slice.
+        # np/jnp stack both work: raw host groups arrive as np, resized
+        # device groups as jax arrays (rows sliced lazily)
+        xp = jnp if not isinstance(group, np.ndarray) else np
+        quant = [self.pair_runner.dispatch(xp.stack([g[:-1], g[1:]],
                                                     axis=1))[:t]
                  for g in group]
         return jnp.stack(quant)

@@ -206,3 +206,23 @@ def device_resize(batch_u8, rmat, cmat):
     x = jnp.clip(jnp.round(x), 0.0, 255.0)    # PIL's inter-pass uint8 store
     x = jnp.einsum("oh,bhwc->bowc", rmat, x)  # vertical pass
     return jnp.clip(jnp.round(x), 0.0, 255.0)
+
+
+def make_device_resizer(in_h: int, in_w: int, oh: int, ow: int,
+                        interpolation: str = "bilinear"):
+    """Returns a jittable fn resizing (..., in_h, in_w, C) uint8 frames to
+    (..., oh, ow, C) uint8 via :func:`device_resize` (any leading dims are
+    flattened for the matmuls and restored). Output is uint8 — device_resize
+    already rounds and clamps, so the cast is exact and matches PIL's uint8
+    output byte for byte (within its 2-LSB envelope) while quartering the
+    resident size of resized intermediates."""
+    import jax.numpy as jnp
+    rmat = pil_resize_matrix(in_h, oh, interpolation)
+    cmat = pil_resize_matrix(in_w, ow, interpolation)
+
+    def resize_frames(x_u8):
+        lead, tail = x_u8.shape[:-3], x_u8.shape[-3:]
+        out = device_resize(x_u8.reshape((-1,) + tail), rmat, cmat)
+        return out.astype(jnp.uint8).reshape(lead + (oh, ow) + tail[-1:])
+
+    return resize_frames

@@ -166,3 +166,49 @@ def test_frame_wise_device_resize_matches_host(sample_video, tmp_path,
     cos = np.sum(a * b, axis=1) / (np.linalg.norm(a, axis=1)
                                    * np.linalg.norm(b, axis=1) + 1e-9)
     assert np.all(cos > 0.999), cos.min()
+
+
+def test_device_resize_mixed_resolutions(sample_video, tmp_path, monkeypatch):
+    """resize=device across videos of different source resolutions: the
+    per-resolution runner cache must produce correct shapes for each (and
+    features for the re-encoded small video must match its own host-path
+    run)."""
+    import cv2
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+    # a second video at half resolution, synthesized from the sample
+    small = str(tmp_path / "v_small.mp4")
+    cap = cv2.VideoCapture(sample_video)
+    w = cv2.VideoWriter(small, cv2.VideoWriter_fourcc(*"mp4v"), 20,
+                        (160, 120))
+    for _ in range(40):
+        ok, frame = cap.read()
+        if not ok:
+            break
+        w.write(cv2.resize(frame, (160, 120)))
+    w.release()
+    cap.release()
+
+    def extractor(resize):
+        args = load_config("resnet", parse_dotlist([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=2", "allow_random_weights=true",
+            f"resize={resize}", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}",
+            f"video_paths=[{sample_video},{small}]"]))
+        sanity_check(args)
+        return get_extractor_cls("resnet")(args)
+
+    ex = extractor("device")
+    big = ex.extract(sample_video)["resnet"]
+    sm = ex.extract(small)["resnet"]
+    assert big.shape[1] == sm.shape[1] == 512 and len(sm) > 0
+    assert len(ex._resize_runners) == 2  # one per source resolution
+    # the small video agrees with its own host-path extraction
+    sm_host = extractor("host").extract(small)["resnet"]
+    cos = np.sum(sm * sm_host, axis=1) / (
+        np.linalg.norm(sm, axis=1) * np.linalg.norm(sm_host, axis=1) + 1e-9)
+    assert np.all(cos > 0.999), cos.min()

@@ -164,7 +164,13 @@ class ExtractI3D(BaseExtractor):
         timestamps_ms: List[float] = []
         feats: Dict[str, List] = {s: [] for s in self.streams}
         stacks_done = 0
-        res_runners = None  # (rgb_runner, pair_runner) under resize=device
+        res_runners = None  # (resize_runner, rgb_runner) under resize=device
+        from ..parallel.mesh import FeatureStream
+        # bounded cross-group pipeline: one runner-less stream per i3d
+        # stream; flush() dispatches itself and hands the device arrays in,
+        # so decode of group k+1 overlaps device compute of group k while at
+        # most 2 groups' results wait un-materialized
+        queues = {s: FeatureStream(None, depth=2) for s in self.streams}
 
         def flush():
             nonlocal stacks_done
@@ -185,18 +191,14 @@ class ExtractI3D(BaseExtractor):
                     # resize=device: raw group crosses H2D once, resized
                     # once, and the uint8 result feeds both streams
                     resized = res_runners[0].dispatch(group)[:len(group)]
-                    pending = [
-                        (s, res_runners[1].dispatch(resized) if s == "rgb"
-                         else self._flow_stream.dispatch_resized(resized))
-                        for s in self.streams]
+                    for s in self.streams:
+                        dev = (res_runners[1].dispatch(resized) if s == "rgb"
+                               else self._flow_stream.dispatch_resized(resized))
+                        queues[s].submit_device(dev, len(group))
                 else:
-                    pending = [(s, self.dispatch_stream(s, group))
-                               for s in self.streams]
-                from ..utils.profiling import profiler
-                for stream, dev in pending:
-                    with profiler.stage("forward"):  # the blocking D2H sync
-                        out = np.asarray(dev)[:len(group)]
-                    feats[stream].extend(list(out))
+                    for s in self.streams:
+                        queues[s].submit_device(
+                            self.dispatch_stream(s, group), len(group))
             stacks_done += len(group)
 
         # decode-ahead roughly one stack while the previous stack is on-device
@@ -215,6 +217,9 @@ class ExtractI3D(BaseExtractor):
                 if len(stacks) == self.clip_batch_size:
                     flush()
         flush()
+        for s in self.streams:
+            for out in queues[s].finish():
+                feats[s].extend(list(out))
 
         out = {s: np.array(v) for s, v in feats.items()}
         out["fps"] = np.array(src.fps)

@@ -246,7 +246,7 @@ class FeatureStream:
     extractors.
     """
 
-    def __init__(self, runner: DataParallelApply, depth: int = 4,
+    def __init__(self, runner: Optional[DataParallelApply], depth: int = 4,
                  callback: Optional[Callable[[np.ndarray, Any], None]] = None):
         from collections import deque
         self.runner = runner
@@ -258,11 +258,22 @@ class FeatureStream:
     def submit(self, batch_np: np.ndarray, n_valid: Optional[int] = None,
                ctx: Any = None) -> None:
         n = batch_np.shape[0] if n_valid is None else n_valid
-        if self.callback is None:
-            ctx = None  # don't pin (possibly large) host batches in the queue
         while self._inflight and len(self._inflight) >= self.depth:
             self._pop()  # drain BEFORE dispatching: bound holds during _pop
-        self._inflight.append((self.runner.dispatch(batch_np), n, ctx))
+        self.submit_device(self.runner.dispatch(batch_np), n, ctx)
+
+    def submit_device(self, dev: jnp.ndarray, n_valid: int,
+                      ctx: Any = None) -> None:
+        """Enqueue an ALREADY-dispatched device array (multi-runner
+        pipelines, e.g. i3d's per-stream chains, dispatch themselves); the
+        stream still bounds retained results and materializes in order. A
+        runner-less stream (``FeatureStream(None, ...)``) supports only this
+        entry point."""
+        if self.callback is None:
+            ctx = None  # don't pin (possibly large) host batches in the queue
+        while self._inflight and len(self._inflight) >= max(self.depth, 1):
+            self._pop()
+        self._inflight.append((dev, n_valid, ctx))
         if self.depth == 0:
             self._pop()
 

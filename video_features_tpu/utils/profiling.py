@@ -8,9 +8,11 @@ Here profiling is a first-class subsystem:
     hot phases in ``with profiler.stage("decode")`` etc.; when disabled the
     context manager is a no-op (two attribute reads), so instrumentation
     stays in place permanently. Stages used by the built-in pipelines:
-    ``decode`` (cv2 read + host transform), ``forward`` (H2D + jitted
-    forward + D2H: the DataParallelApply call blocks on the host copy, so
-    this is true device wall time), ``write`` (sink IO).
+    ``decode`` (cv2 read + host transform), ``forward``, ``write`` (sink
+    IO). Under the synchronous path ``forward`` is true H2D + forward + D2H
+    wall time; under async dispatch (FeatureStream, the default) it is the
+    host's *stall* time materializing results — near-zero ``forward`` means
+    the chip is fully hidden behind decode (see docs/performance.md).
   - ``profile=true`` on the CLI prints the aggregate per-stage breakdown at
     the end of the run — the decode-vs-forward-vs-write split that tells
     you whether the chip or the host is the bottleneck.

@@ -187,3 +187,21 @@ def test_tensor_parallel_clip_matches_replicated():
     shard_shapes = {s.data.shape for s in qk.addressable_shards}
     assert shard_shapes == {(64, 32)}, shard_shapes  # (D, D/2) per device
     np.testing.assert_allclose(tp(x), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_feature_stream_submit_device_runnerless():
+    """submit_device: a runner-less stream accepts already-dispatched device
+    arrays (i3d's per-stream queues), bounds retained results, and
+    materializes in order with valid-row trimming."""
+    from video_features_tpu.parallel.mesh import FeatureStream
+    mesh = get_mesh(n_devices=1)
+    runner = DataParallelApply(lambda p, b: b * 3.0, {}, mesh=mesh)
+    stream = FeatureStream(None, depth=2)
+    batches = [np.full((4, 2), i, np.float32) for i in range(5)]
+    for i, b in enumerate(batches):
+        stream.submit_device(runner.dispatch(b), n_valid=3)
+        assert len(stream._inflight) <= 2
+    got = stream.finish()
+    assert [g.shape for g in got] == [(3, 2)] * 5
+    for i, g in enumerate(got):  # submit order preserved
+        np.testing.assert_allclose(g, batches[i][:3] * 3.0)

@@ -1,0 +1,6 @@
+# Regular package marker. Several test modules import the shared torch
+# oracles as `tests.torch_oracles`; other modules put /root/reference on
+# sys.path ahead of the repo root, whose own `tests/` directory would then
+# shadow this one as a *namespace* package (no torch_oracles) depending on
+# import order. A regular package always wins over namespace candidates, so
+# this file pins the resolution regardless of path/import order.

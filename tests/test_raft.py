@@ -126,3 +126,33 @@ def test_flow_viz_matches_reference():
     flow = rng.uniform(-12, 12, size=(32, 40, 2)).astype(np.float32)
     np.testing.assert_array_equal(flow_viz.flow_to_image(flow),
                                   ref.flow_to_image(flow))
+
+
+def test_raft_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
+    """resize=device with side_size: the fused MXU resize in front of the
+    flow net must match the host-PIL path closely (flow endpoint error well
+    under a pixel for 2-LSB input deltas)."""
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+
+    def feats(resize):
+        args = load_config("raft", parse_dotlist([
+            "feature_type=raft", "device=cpu", "batch_size=4",
+            "extraction_fps=1", "side_size=128", "allow_random_weights=true",
+            f"resize={resize}", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}", f"video_paths={sample_video}"]))
+        sanity_check(args)
+        return get_extractor_cls("raft")(args).extract(sample_video)
+
+    host = feats("host")
+    dev = feats("device")
+    np.testing.assert_array_equal(host["timestamps_ms"],
+                                  dev["timestamps_ms"])
+    a, b = host["raft"], dev["raft"]  # (N, 2, H, W)
+    assert a.shape == b.shape and a.shape[1] == 2
+    err = np.abs(a - b)
+    assert np.median(err) < 0.1 and np.percentile(err, 99) < 1.0, \
+        (np.median(err), np.percentile(err, 99))

@@ -47,6 +47,31 @@ class BaseExtractor:
             return runner.stream(depth=0, callback=on_result)
         return runner.stream(depth=depth)
 
+    def _resolve_resize_mode(self, args: Config) -> str:
+        """Shared ``resize=host|device`` validation + the per-source-
+        resolution runner cache used by every device-resize pipeline
+        (frame-wise, flow, i3d): a lock-guarded (video_workers share it)
+        FIFO-bounded dict keyed by source (h, w)."""
+        import threading
+        mode = args.get("resize") or "host"
+        if mode not in ("host", "device"):
+            raise NotImplementedError(f"resize={mode!r}: expected 'host' "
+                                      "or 'device'")
+        self._resize_runners: Dict = {}
+        self._resize_lock = threading.Lock()
+        return mode
+
+    def _cached_resize_runner(self, key, build):
+        """Build-once per source resolution, bounded to 8 executables."""
+        with self._resize_lock:
+            runner = self._resize_runners.get(key)
+            if runner is None:
+                if len(self._resize_runners) >= 8:
+                    self._resize_runners.pop(
+                        next(iter(self._resize_runners)), None)
+                runner = self._resize_runners[key] = build()
+            return runner
+
     def _resolve_ingest(self, args: Config, default: str) -> str:
         """Validate the host->device wire format against the subclass's
         ``supported_ingest`` (shared by the clip-stack and frame-wise

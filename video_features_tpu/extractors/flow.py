@@ -44,8 +44,28 @@ class OpticalFlowExtractor(BaseExtractor):
         self.extraction_total = args.get("extraction_total")
         self.output_feat_keys = [self.feature_type, "fps", "timestamps_ms"]
         self.runner: Optional[DataParallelApply] = None
+        #: set by subclasses for resize=device: the family forward taking
+        #: uint8 pairs at the (resized) working geometry, and a builder
+        #: producing a runner around a wrapped fwd with shared committed
+        #: params (same pattern as frame_wise.py)
+        self.base_fwd: Optional[Callable] = None
+        self.runner_builder: Optional[Callable] = None
 
-        if self.side_size is not None:
+        #: resize=device (only meaningful with side_size): the per-frame PIL
+        #: edge resize moves onto the MXU in front of the flow net; the host
+        #: ships raw decoded frames. At small side_size the flow nets outrun
+        #: a CPU core's PIL filtering, so this keeps the chip fed.
+        self.resize_mode = self._resolve_resize_mode(args)
+        if self.side_size is None:
+            self.resize_mode = "host"  # no resize in the pipeline at all
+        if self.resize_mode == "device" and self.show_pred:
+            # show_pred overlays flow on the (resized) RGB frames, which the
+            # host no longer has under device resize
+            print("WARNING: resize=device is unsupported with show_pred; "
+                  "using resize=host")
+            self.resize_mode = "host"
+
+        if self.side_size is not None and self.resize_mode == "host":
             from ..ops import preprocess as pp
             side = int(self.side_size)
             smaller = self.resize_to_smaller_edge
@@ -56,6 +76,33 @@ class OpticalFlowExtractor(BaseExtractor):
             self.host_transform: Optional[Callable] = transform
         else:
             self.host_transform = None
+
+    def _init_flow_runner(self, fwd, params, mesh) -> None:
+        """Family-shared runner construction: the base runner plus the
+        committed-param builder the device-resize cache wraps."""
+        self.base_fwd = fwd
+        self.runner = DataParallelApply(fwd, params, mesh=mesh,
+                                        fixed_batch=self.batch_size)
+        committed = self.runner.params  # one HBM copy across resolutions
+        self.runner_builder = lambda f: DataParallelApply(
+            f, committed, mesh=mesh, fixed_batch=self.batch_size)
+
+    def _device_resize_runner(self, in_h: int, in_w: int) -> DataParallelApply:
+        """Per-source-resolution runner: edge resize fused in front of the
+        flow forward; committed params shared (one HBM copy)."""
+        def build():
+            from ..ops import preprocess as pp
+            ow, oh = pp.resize_edge_size(in_w, in_h, int(self.side_size),
+                                         self.resize_to_smaller_edge)
+            resize = pp.make_device_resizer(in_h, in_w, oh, ow)
+            base = self.base_fwd
+
+            def fwd(params, raw_pairs_u8):  # (B, 2, in_h, in_w, 3)
+                return base(params, resize(raw_pairs_u8))
+
+            return self.runner_builder(fwd)
+
+        return self._cached_resize_runner((in_h, in_w), build)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         video = VideoSource(
@@ -69,11 +116,7 @@ class OpticalFlowExtractor(BaseExtractor):
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         first = True
-        # async dispatch, shallow window: each pending output is a full
-        # (B, H, W, 2) float field, so at most 2 wait on-device at once
-        stream = self.feature_stream(
-            self.runner, depth=2,
-            on_result=lambda flows, arr: self.maybe_show_pred(flows, arr))
+        stream = None
         # decode-ahead: the next batch decodes while this one is on-device
         for batch, ts, _ in Prefetcher(video):
             if len(batch) < 2:
@@ -82,13 +125,24 @@ class OpticalFlowExtractor(BaseExtractor):
                 timestamps_ms.extend(ts if first else ts[1:])
                 first = False
                 continue
+            if stream is None:
+                # resize=device keys the fused-resize runner off the first
+                # decoded frame's shape; async dispatch with a shallow
+                # window: each pending output is a full (B, H, W, 2) float
+                # field, so at most 2 wait on-device at once
+                runner = (self._device_resize_runner(*batch[0].shape[:2])
+                          if self.resize_mode == "device" else self.runner)
+                stream = self.feature_stream(
+                    runner, depth=2,
+                    on_result=lambda flows, a: self.maybe_show_pred(flows, a))
             arr = np.stack(batch)  # (n, H, W, 3) uint8
             pairs = np.stack([arr[:-1], arr[1:]], axis=1)
             stream.submit(pairs, ctx=arr)
             timestamps_ms.extend(ts if first else ts[1:])
             first = False
-        for flows in stream.finish():  # (n-1, H, W, 2) float32 per batch
-            vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
+        if stream is not None:
+            for flows in stream.finish():  # (n-1, H, W, 2) float32 per batch
+                vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
         return {
             self.feature_type: np.array(vid_feats),
             "fps": np.array(video.fps),

@@ -56,10 +56,7 @@ class FrameWiseExtractor(BaseExtractor):
         #: within 2 LSB of PIL). The host then only decodes; raw frames ship
         #: as uint8. Subclasses declare resize_spec/crop_size/base_fwd/
         #: runner_builder to opt in.
-        self.resize_mode = args.get("resize") or "host"
-        if self.resize_mode not in ("host", "device"):
-            raise NotImplementedError(f"resize={self.resize_mode!r}: "
-                                      "expected 'host' or 'device'")
+        self.resize_mode = self._resolve_resize_mode(args)
         if self.resize_mode == "device" and self.ingest != "uint8":
             raise NotImplementedError(
                 "resize=device ships raw decoded frames (ingest=uint8); "
@@ -68,9 +65,6 @@ class FrameWiseExtractor(BaseExtractor):
         self.crop_size: Optional[int] = None
         self.base_fwd: Optional[Callable] = None
         self.runner_builder: Optional[Callable] = None
-        self._resize_runners: Dict = {}
-        import threading
-        self._resize_lock = threading.Lock()  # video_workers share the cache
 
     def encode_wire_u8(self, u8: np.ndarray) -> np.ndarray:
         """uint8 HWC frame -> the configured wire format (transform tail)."""
@@ -86,30 +80,25 @@ class FrameWiseExtractor(BaseExtractor):
         the host path); all runners share the committed device param arrays
         (DataParallelApply's device_put of an already-committed tree with the
         same sharding is a no-op), so weights live in HBM once."""
-        key = (in_h, in_w)
-        with self._resize_lock:
-            runner = self._resize_runners.get(key)
-            if runner is None:
-                from ..ops import preprocess as pp
-                size, interp, smaller = self.resize_spec
-                if isinstance(size, int):
-                    ow, oh = pp.resize_edge_size(in_w, in_h, size, smaller)
-                else:
-                    oh, ow = size
-                resize = pp.make_device_resizer(in_h, in_w, oh, ow, interp)
-                c = self.crop_size
-                i, j = pp.center_crop_offsets(oh, ow, c, c)
-                base = self.base_fwd
+        def build():
+            from ..ops import preprocess as pp
+            size, interp, smaller = self.resize_spec
+            if isinstance(size, int):
+                ow, oh = pp.resize_edge_size(in_w, in_h, size, smaller)
+            else:
+                oh, ow = size
+            resize = pp.make_device_resizer(in_h, in_w, oh, ow, interp)
+            c = self.crop_size
+            i, j = pp.center_crop_offsets(oh, ow, c, c)
+            base = self.base_fwd
 
-                def fwd(params, raw_u8):
-                    x = resize(raw_u8)
-                    return base(params, x[:, i:i + c, j:j + c, :])
+            def fwd(params, raw_u8):
+                x = resize(raw_u8)
+                return base(params, x[:, i:i + c, j:j + c, :])
 
-                if len(self._resize_runners) >= 8:  # bound executable count
-                    self._resize_runners.pop(
-                        next(iter(self._resize_runners)), None)
-                runner = self._resize_runners[key] = self.runner_builder(fwd)
-            return runner
+            return self.runner_builder(fwd)
+
+        return self._cached_resize_runner((in_h, in_w), build)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         device_resize = self.resize_mode == "device"

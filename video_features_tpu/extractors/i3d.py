@@ -98,17 +98,11 @@ class ExtractI3D(BaseExtractor):
         # in front of both streams instead (ops/preprocess.py device_resize)
         # and ship raw decoded frames. show_pred needs per-stack host frames
         # at the resized geometry, so it keeps the host path.
-        self.resize_mode = args.get("resize") or "host"
-        if self.resize_mode not in ("host", "device"):
-            raise NotImplementedError(f"resize={self.resize_mode!r}: "
-                                      "expected 'host' or 'device'")
+        self.resize_mode = self._resolve_resize_mode(args)
         if self.resize_mode == "device" and self.show_pred:
             print("WARNING: resize=device is unsupported with show_pred; "
                   "using resize=host")
             self.resize_mode = "host"
-        self._res_runners: Dict = {}
-        import threading
-        self._res_lock = threading.Lock()  # video_workers share this cache
         self.host_transform = None if self.resize_mode == "device" \
             else transform
 
@@ -120,11 +114,7 @@ class ExtractI3D(BaseExtractor):
         raw frames cross H2D once and each frame is resized once. Committed
         backbone params are shared with the base runners (one HBM copy);
         bounded cache, one entry per source resolution."""
-        key = (in_h, in_w)
-        with self._res_lock:
-            got = self._res_runners.get(key)
-            if got is not None:
-                return got
+        def build():
             mesh = (self.runners.get("rgb")
                     or self._flow_stream.pair_runner).mesh
             ow, oh = pp.resize_edge_size(in_w, in_h, self.min_side_size)
@@ -146,10 +136,9 @@ class ExtractI3D(BaseExtractor):
                 rgb_runner = DataParallelApply(
                     rgb_fwd, base.params, mesh=base.mesh,
                     fixed_batch=self.clip_batch_size)
-            if len(self._res_runners) >= 8:  # bound executable count
-                self._res_runners.pop(next(iter(self._res_runners)), None)
-            got = self._res_runners[key] = (resize_runner, rgb_runner)
-            return got
+            return (resize_runner, rgb_runner)
+
+        return self._cached_resize_runner((in_h, in_w), build)
 
     def _init_flow_stream(self, args, mesh, dtype, allow_random) -> None:
         from . import i3d_flow

@@ -37,6 +37,5 @@ class ExtractPWC(OpticalFlowExtractor):
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
         mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
-        self.runner = DataParallelApply(
-            partial(_pwc_forward, self.model), params, mesh=mesh,
-            fixed_batch=self.batch_size)
+        self._init_flow_runner(partial(_pwc_forward, self.model), params,
+                               mesh)

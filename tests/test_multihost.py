@@ -166,3 +166,47 @@ def test_sigterm_graceful_preemption(sample_video, tmp_path):
                        timeout=600)
     assert r.returncode == 0, r.stderr.decode()[-2000:]
     assert len(list(out.rglob("*_resnet.npy"))) == 8
+
+
+def test_video_workers_with_device_resize(sample_video, tmp_path,
+                                          monkeypatch):
+    """video_workers=2 + resize=device over two different source
+    resolutions: the lock-guarded per-resolution runner cache is exercised
+    from concurrent threads and outputs must match the serial run."""
+    import cv2
+    import pytest
+    from video_features_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "weights"))
+    second = str(tmp_path / "v_small_dr.mp4")
+    cap = cv2.VideoCapture(sample_video)
+    wtr = cv2.VideoWriter(second, cv2.VideoWriter_fourcc(*"mp4v"), 20,
+                          (160, 120))
+    if not wtr.isOpened():  # same guard as conftest._synthesize_sample
+        pytest.skip("cv2 cannot encode mp4v")
+    for _ in range(30):
+        ok, frame = cap.read()
+        if not ok:
+            break
+        wtr.write(cv2.resize(frame, (160, 120)))
+    wtr.release()
+    cap.release()
+
+    def run(out, workers):
+        cli_main([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=2", "allow_random_weights=true",
+            "resize=device", f"video_workers={workers}",
+            "on_extraction=save_numpy", f"output_path={out}",
+            f"tmp_path={tmp_path / 'tmp'}",
+            f"video_paths=[{sample_video},{second}]",
+        ])
+        return {p.name: np.load(p)
+                for p in sorted((out / "resnet" / "resnet18").glob("*.npy"))}
+
+    serial = run(tmp_path / "serial", 1)
+    threaded = run(tmp_path / "threaded", 2)
+    assert serial.keys() == threaded.keys() and len(serial) == 6
+    for name in serial:
+        np.testing.assert_array_equal(serial[name], threaded[name],
+                                      err_msg=name)

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models import pwc as pwc_model
-from ..parallel.mesh import DataParallelApply, get_mesh
+from ..parallel.mesh import get_mesh
 from ..weights import store
 from .flow import OpticalFlowExtractor
 

@@ -48,3 +48,19 @@ def test_ring_attention_long_sequence_memory_shape(rng, seq_mesh):
     ref = np.asarray(dense_attention(q, k, v))
     out = np.asarray(ring_attention(q, k, v, mesh=seq_mesh))
     np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_blockwise_attention_matches_dense(rng):
+    """Single-device FlashAttention-style recurrence: exact vs dense for
+    causal and non-causal, block-divisible and ragged T, block >= T."""
+    from video_features_tpu.parallel.sequence import (blockwise_attention,
+                                                      dense_attention)
+    for t, bs in ((32, 8), (37, 8), (16, 64)):
+        q, k, v = (jnp.asarray(rng.normal(size=(2, t, 3, 8))
+                               .astype(np.float32)) for _ in range(3))
+        for causal in (False, True):
+            got = blockwise_attention(q, k, v, block_size=bs, causal=causal)
+            want = dense_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5,
+                                       err_msg=f"t={t} bs={bs} causal={causal}")

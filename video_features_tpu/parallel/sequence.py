@@ -17,11 +17,17 @@ single-chip memory wall:
     dense attention for H/n heads over the FULL sequence, then the layout is
     swapped back. One collective pair per attention call; best when
     n_devices <= n_heads and T*T/n scores fit.
+  - :func:`blockwise_attention` — the INTRA-device path: the same streaming
+    log-sum-exp recurrence over K/V blocks on one device (FlashAttention at
+    the XLA level), O(T * block_size) score memory. Compose with
+    ring/Ulysses when a single shard's sequence is itself too long to score
+    densely.
 
-Both are written as shard_map bodies (take ``axis_name``) plus convenience
-wrappers that build the shard_map over a 1-D ``seq`` mesh. Both support the
-causal mask (global positions reconstructed from the device index, so the
-mask is exact across shards). Numerics are validated against dense softmax
+The sharded pair are written as shard_map bodies (take ``axis_name``) plus
+convenience wrappers that build the shard_map over a 1-D ``seq`` mesh. All
+support the causal mask (global positions reconstructed from the device
+index, so the mask is exact across shards); all share one streaming-softmax
+fold (:func:`_softmax_fold`). Numerics are validated against dense softmax
 attention on the 8-device CPU mesh in tests/test_sequence_parallel.py.
 """
 from __future__ import annotations
@@ -51,6 +57,72 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _softmax_fold(q, acc, ck, cv, scale, valid):
+    """Fold one K/V block into the streaming-softmax accumulator
+    ``(o, m, l)`` — unnormalized output, running max, normalizer. ``valid``
+    is an optional (tq, tk) bool mask (causal and/or padding); the -inf
+    guards keep fully-masked rows finite. Shared by the ring and blockwise
+    paths so the delicate numerics live once."""
+    o, m, l = acc
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                   preferred_element_type=jnp.float32) * scale
+    if valid is not None:
+        s = jnp.where(valid, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    if valid is not None:
+        p = jnp.where(jnp.isinf(s), 0.0, p)
+    alpha = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - m_safe))
+    o = o * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, cv,
+                               preferred_element_type=jnp.float32)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    return o, m_new, l
+
+
+def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-device memory-efficient attention (B, T, H, D) -> same.
+
+    The intra-device complement of :func:`ring_attention`: a ``lax.scan``
+    over K/V blocks with the same streaming log-sum-exp softmax, so peak
+    score memory is O(T * block_size) instead of O(T^2) — the
+    FlashAttention recurrence expressed at the XLA level. Use it when one
+    device's sequence shard is itself too long to score densely; compose
+    with ring/Ulysses for the cross-device axis. T need not divide
+    block_size (keys pad with a mask).
+    """
+    b, t, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    bs = min(block_size, t)
+    n_blocks = -(-t // bs)
+    pad = n_blocks * bs - t
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (n_blocks, B, bs, H, D) scan sequence
+    kb = jnp.moveaxis(kp.reshape(b, n_blocks, bs, h, d), 1, 0)
+    vb = jnp.moveaxis(vp.reshape(b, n_blocks, bs, h, d), 1, 0)
+    q_pos = jnp.arange(t)
+
+    def step(acc, blk):
+        o, m, l, i = acc
+        ck, cv = blk
+        k_pos = i * bs + jnp.arange(bs)
+        valid = k_pos[None, :] < t
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        o, m, l = _softmax_fold(q, (o, m, l), ck, cv, scale, valid)
+        return (o, m, l, i + 1), None
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    (o, _, l, _), _ = jax.lax.scan(step, (o0, m0, l0, 0), (kb, vb))
+    out = o / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            axis_name: str, causal: bool = False,
                            scale: Optional[float] = None) -> jnp.ndarray:
@@ -70,24 +142,12 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     q_pos = me * t_local + jnp.arange(t_local)  # global query positions
 
     def fold(acc, ck, cv, src):
-        """Fold one K/V block into the streaming-softmax accumulator."""
-        o, m, l = acc
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                       preferred_element_type=jnp.float32) * scale
+        """Fold the K/V shard currently held (originally device ``src``)."""
+        valid = None
         if causal:
             k_pos = src * t_local + jnp.arange(t_local)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # fully-masked rows keep m=-inf; exp(-inf - -inf) guard:
-        m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe)
-        if causal:
-            p = jnp.where(jnp.isinf(s), 0.0, p)
-        alpha = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - m_safe))
-        o = o * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, cv,
-                                   preferred_element_type=jnp.float32)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        return o, m_new, l
+            valid = q_pos[:, None] >= k_pos[None, :]
+        return _softmax_fold(q, acc, ck, cv, scale, valid)
 
     o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""End-to-end throughput harness: wall-clock videos/s and frames/s through
+the REAL pipeline (decode -> transform -> device -> sink), per family and
+knob set.
+
+`bench.py` measures the chip-side step in isolation; this measures what a
+user actually gets, including host decode — the usual bottleneck
+(SURVEY §7 hard part 3) — so it is the tool for evaluating the host-side
+knobs (`resize=device`, `video_workers`, `ingest=`, `precision=`).
+
+Usage (any main.py key=value passes through):
+
+    python scripts/throughput.py feature_type=resnet model_name=resnet18 \
+        device=cpu extraction_fps=8 resize=device --repeat 4
+
+    # compare two knob sets on the same inputs
+    python scripts/throughput.py feature_type=r21d --repeat 4 -- \
+        resize=host :: resize=device
+
+Prints one JSON line per knob set:
+    {"config": ..., "videos": N, "seconds": S, "videos_per_s": ...,
+     "frames_per_s": ...}
+
+The sample video (/root/reference/sample/*.mp4 when present) is copied
+``--repeat`` times under distinct stems so the idempotent skip never
+hides work; outputs go to a throwaway temp dir.
+"""
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SAMPLE = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+
+
+def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
+    from video_features_tpu.cli import main as cli_main
+    out = workdir / f"out_{tag}"
+    args = list(base_args) + [
+        "on_extraction=save_numpy", f"output_path={out}",
+        f"tmp_path={workdir / 'tmp'}",
+        f"video_paths=[{','.join(videos)}]",
+    ]
+    t0 = time.perf_counter()
+    cli_main(args)
+    dt = time.perf_counter() - t0
+    import numpy as np
+    result = {
+        "config": " ".join(a for a in base_args),
+        "videos": len(videos),
+        "seconds": round(dt, 2),
+        "videos_per_s": round(len(videos) / dt, 3),
+    }
+    ts_files = list(out.rglob("*_timestamps_ms.npy"))
+    if ts_files:  # frame-wise / flow families: one row per frame
+        frames = int(sum(np.load(f).shape[0] for f in ts_files))
+        result["frames_per_s"] = round(frames / dt, 1)
+    else:  # clip-stack families: one feature row per clip window
+        ft = next((a.split("=", 1)[1] for a in base_args
+                   if a.startswith("feature_type=")), None)
+        feat_files = list(out.rglob(f"*_{ft}.npy")) if ft else []
+        if feat_files:
+            clips = int(sum(np.load(f).shape[0] for f in feat_files))
+            result["clips_per_s"] = round(clips / dt, 2)
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeat", type=int, default=2,
+                    help="copies of the sample video (distinct stems)")
+    ap.add_argument("--video", default=str(SAMPLE),
+                    help="source video to replicate")
+    # key=value / '::' tokens come back via parse_known_args, so --repeat
+    # and --video are recognized wherever they appear on the command line
+    opts, rest = ap.parse_known_args()
+    rest = [a for a in rest if a != "--"]
+    bad = [a for a in rest if a != "::" and "=" not in a]
+    if bad:
+        raise SystemExit(f"unrecognized arguments: {bad} "
+                         "(expected key=value, '::', --repeat, --video)")
+    if "::" in rest:
+        # args before the first '::' are common; each '::'-separated tail
+        # group is one variant compared on the same inputs
+        idx = rest.index("::")
+        common, groups, cur = rest[:idx], [], []
+        for a in rest[idx + 1:]:
+            if a == "::":
+                groups.append(cur)
+                cur = []
+            else:
+                cur.append(a)
+        groups.append(cur)
+        configs = [common + g for g in groups]
+    else:
+        configs = [rest]
+
+    src = Path(opts.video)
+    if not src.exists():
+        raise SystemExit(f"source video not found: {src}")
+    with tempfile.TemporaryDirectory(prefix="vft_throughput_") as td:
+        workdir = Path(td)
+        videos = []
+        for i in range(opts.repeat):
+            dst = workdir / f"v_tp_{i:03d}.mp4"
+            shutil.copy(src, dst)
+            videos.append(str(dst))
+        for i, cfg in enumerate(configs):
+            print(json.dumps(run_config(cfg, videos, workdir, str(i))))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,19 @@ def dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _fold_init(b, h, t, d):
+    """Fresh streaming-softmax accumulator (o, m, l), f32."""
+    return (jnp.zeros((b, h, t, d), jnp.float32),
+            jnp.full((b, h, t, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, t, 1), jnp.float32))
+
+
+def _fold_finalize(o, l, dtype):
+    """Normalize + (B, H, T, D) -> (B, T, H, D) in the caller's dtype."""
+    out = o / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(dtype)
+
+
 def _softmax_fold(q, acc, ck, cv, scale, valid):
     """Fold one K/V block into the streaming-softmax accumulator
     ``(o, m, l)`` — unnormalized output, running max, normalizer. ``valid``
@@ -115,12 +128,9 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         o, m, l = _softmax_fold(q, (o, m, l), ck, cv, scale, valid)
         return (o, m, l, i + 1), None
 
-    o0 = jnp.zeros((b, h, t, d), jnp.float32)
-    m0 = jnp.full((b, h, t, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    o0, m0, l0 = _fold_init(b, h, t, d)
     (o, _, l, _), _ = jax.lax.scan(step, (o0, m0, l0, 0), (kb, vb))
-    out = o / jnp.maximum(l, 1e-30)
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    return _fold_finalize(o, l, q.dtype)
 
 
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -149,9 +159,7 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             valid = q_pos[:, None] >= k_pos[None, :]
         return _softmax_fold(q, acc, ck, cv, scale, valid)
 
-    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
-    m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    o0, m0, l0 = _fold_init(b, h, t_local, d)
     if hasattr(jax.lax, "pcast"):
         # the accumulators become device-varying after one scan step; the
         # replicated initializers must be cast so the carry types are stable
@@ -172,8 +180,7 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (o, m, l, ck, cv), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n - 1))
     o, _, l = fold((o, m, l), ck, cv, src=(me - (n - 1)) % n)
-    out = o / jnp.maximum(l, 1e-30)
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    return _fold_finalize(o, l, q.dtype)
 
 
 def ulysses_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -106,3 +106,23 @@ def test_compilation_cache_knob(monkeypatch, tmp_path):
     assert calls["jax_compilation_cache_dir"] == str(tmp_path / "env")
     _enable_compilation_cache(dict(compilation_cache_dir=str(tmp_path / "x")))
     assert calls["jax_compilation_cache_dir"] == str(tmp_path / "x")
+
+
+def test_video_workers_auto(tmp_path):
+    """video_workers=auto resolves to a bounded thread count in the CLI and
+    is forced to 1 under print/show_pred by sanity_check."""
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+
+    args = load_config("resnet", parse_dotlist(
+        ["feature_type=resnet", "video_workers=auto",
+         "video_paths=/root/reference/sample/v_GGSY1Qvo990.mp4"]))
+    sanity_check(args)  # on_extraction defaults to print
+    assert args.video_workers == 1
+    args2 = load_config("resnet", parse_dotlist(
+        ["feature_type=resnet", "video_workers=auto",
+         "on_extraction=save_numpy", f"output_path={tmp_path / 'o'}",
+         f"tmp_path={tmp_path / 't'}",
+         "video_paths=/root/reference/sample/v_GGSY1Qvo990.mp4"]))
+    sanity_check(args2)
+    assert args2.video_workers == "auto"  # resolved at run time in cli.main

@@ -115,7 +115,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             stop.set()
         prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
-    workers = int(args.get("video_workers") or 1)
+    workers_arg = args.get("video_workers") or 1
+    if workers_arg == "auto":  # sanity_check normalized/validated strings
+        # decode threads beyond the core count just contend; beyond ~8 the
+        # single device queue is the limiter anyway
+        import os as _os
+        workers_arg = max(1, min(8, (_os.cpu_count() or 1) // 2))
+    workers = int(workers_arg)
     tally = {"done": 0, "skipped": 0, "error": 0}
     tally_lock = threading.Lock()
     t_run = time.perf_counter()

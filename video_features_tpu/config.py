@@ -203,7 +203,14 @@ def sanity_check(args: Config) -> None:
     if args.get("show_pred") and args.feature_type == "vggish":
         print("Showing class predictions is not implemented for VGGish")
 
-    if int(args.get("video_workers") or 1) > 1 and (
+    vw = args.get("video_workers") or 1
+    if isinstance(vw, str):
+        vw = vw.strip().lower()
+        if vw != "auto":
+            raise ValueError(f"video_workers={vw!r}: expected an int or "
+                             "'auto'")
+        args.video_workers = vw
+    if (vw == "auto" or int(vw) > 1) and (
             args.get("on_extraction", "print") == "print"
             or args.get("show_pred")):
         # concurrent videos would interleave their stdout dumps line-by-line

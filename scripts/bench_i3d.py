@@ -33,6 +33,11 @@ ITERS = 10
 def bench_ours() -> float:
     import jax
     import jax.numpy as jnp
+    if jax.default_backend() != "cpu":
+        # persistent compile cache (safe off-CPU — see cli.py): the RAFT
+        # 20-iteration scan costs tens of minutes of XLA compile cold
+        from video_features_tpu.cli import _enable_compilation_cache
+        _enable_compilation_cache({"device": "auto"})
     from video_features_tpu.extractors.i3d import _i3d_forward
     from video_features_tpu.extractors.i3d_flow import _raft_quantized_flow
     from video_features_tpu.models import i3d as i3d_m, raft as raft_m

@@ -13,14 +13,17 @@ Our number is the steady-state jitted forward in the maximum-throughput
 ingest mode (``ingest=yuv420``, including H2D transfer): packed I420 uint8
 clips (1.5 bytes/pixel wire format, colorspace conversion fused on device —
 ops/colorspace.py; the pipeline is H2D-bandwidth-bound), bfloat16 params +
-activations, B=16 clips per step.
+activations, B=64 clips per step.
 
-Measurement note: the loop dispatches all iterations and synchronizes once
-at the end. On a locally-attached TPU that is true wall time. On remotely
-tunneled dev chips, synchronous round trips carry hundreds of ms of tunnel
-latency that no real deployment pays, while dispatch throughput still
-faithfully tracks bytes-on-wire and device occupancy — so the pipelined
-number is the deployment-representative one there too.
+Measurement note: the loop dispatches all iterations and fences once at the
+end with a D2H read of the last output (`settle`) — `block_until_ready` has
+been observed to ack early on tunneled dev chips, which a host read cannot
+(the in-order device queue makes it fence every prior dispatch). One
+~100 ms tunnel round trip amortized over 30 batches. Shared dev chips also
+show large run-to-run variance from other tenants: when healthy, this
+measures MXU-bound throughput (~5,000 clips/s on v5e matches the model's
+FLOPs at peak bf16 almost exactly); congested windows can be 100x slower
+through no fault of the program.
 """
 import json
 import time
@@ -62,13 +65,14 @@ def bench_ours() -> float:
     wire = (BATCH, CLIP[0], packed_size(CLIP[1], CLIP[2]))
     batches = [rng.integers(0, 255, size=wire, dtype=np.uint8)
                for _ in range(2)]
-    forward(params, batches[0]).block_until_ready()  # compile
+    from video_features_tpu.parallel.mesh import settle
+    settle(forward(params, batches[0]))  # compile
     for _ in range(WARMUP):
-        forward(params, batches[1]).block_until_ready()
+        settle(forward(params, batches[1]))
     t0 = time.perf_counter()
     for i in range(ITERS):
         out = forward(params, batches[i % 2])
-    out.block_until_ready()
+    settle(out)
     dt = time.perf_counter() - t0
     return BATCH * ITERS / dt
 

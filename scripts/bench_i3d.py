@@ -63,13 +63,14 @@ def bench_ours() -> float:
     rng = np.random.default_rng(0)
     stacks = [rng.integers(0, 255, size=(STACK + 1, SIDE, SIDE, 3),
                            dtype=np.uint8) for _ in range(2)]
-    jax.block_until_ready(step(raft_p, i3d_rgb, i3d_flow, stacks[0]))
+    from video_features_tpu.parallel.mesh import settle
+    settle(step(raft_p, i3d_rgb, i3d_flow, stacks[0]))
     for _ in range(WARMUP):
-        jax.block_until_ready(step(raft_p, i3d_rgb, i3d_flow, stacks[1]))
+        settle(step(raft_p, i3d_rgb, i3d_flow, stacks[1]))
     t0 = time.perf_counter()
     for i in range(ITERS):
         out = step(raft_p, i3d_rgb, i3d_flow, stacks[i % 2])
-    jax.block_until_ready(out)
+    settle(out)
     return ITERS / (time.perf_counter() - t0)
 
 

@@ -116,6 +116,19 @@ def param_specs_by_rules(params: Any,
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def settle(out: Any) -> float:
+    """Completion fence via a D2H read: sums every leaf of ``out`` on host.
+
+    ``block_until_ready`` has been observed to ack before execution finishes
+    on remotely-tunneled dev chips (yielding physically impossible benchmark
+    rates); a host read of the output cannot return early, and the device's
+    in-order queue makes it fence every prior dispatch. Used by bench.py and
+    scripts/bench_i3d.py.
+    """
+    return float(sum(np.asarray(x).sum()
+                     for x in jax.tree_util.tree_leaves(out)))
+
+
 def cast_floating(tree: Any, dtype) -> Any:
     """Cast every floating-point leaf of a param tree to ``dtype``.
 

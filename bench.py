@@ -34,6 +34,7 @@ CLIP = (16, 112, 112, 3)  # stack, H, W, C
 BATCH = 64  # measured sweet spot on v5e: ~15% over B=16, B=128 flat, B=256 regresses
 WARMUP = 5
 ITERS = 30
+TRIALS = 3  # report the best trial: tenancy stalls on shared dev chips are transient
 
 
 def bench_ours() -> float:
@@ -69,12 +70,15 @@ def bench_ours() -> float:
     settle(forward(params, batches[0]))  # compile
     for _ in range(WARMUP):
         settle(forward(params, batches[1]))
-    t0 = time.perf_counter()
-    for i in range(ITERS):
-        out = forward(params, batches[i % 2])
-    settle(out)
-    dt = time.perf_counter() - t0
-    return BATCH * ITERS / dt
+    best = 0.0
+    for _ in range(TRIALS):  # best-of: shared dev chips stall transiently
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            out = forward(params, batches[i % 2])
+        settle(out)
+        dt = time.perf_counter() - t0
+        best = max(best, BATCH * ITERS / dt)
+    return best
 
 
 def bench_torch_reference() -> float:
@@ -87,14 +91,16 @@ def bench_torch_reference() -> float:
 
     model = TorchR2Plus1D(layers=(2, 2, 2, 2)).eval()
     x = torch.randn(1, 3, *CLIP[:3])
+    best = 0.0
     with torch.no_grad():
         model(x)  # warmup
         n = 3
-        t0 = time.perf_counter()
-        for _ in range(n):
-            model(x)
-        dt = time.perf_counter() - t0
-    return n / dt
+        for _ in range(TRIALS):  # same best-of selection as bench_ours
+            t0 = time.perf_counter()
+            for _ in range(n):
+                model(x)
+            best = max(best, n / (time.perf_counter() - t0))
+    return best
 
 
 def main() -> None:

@@ -23,12 +23,16 @@ from video_features_tpu.models.raft import build_corr_pyramid, corr_lookup
 
 
 def timeit(fn, *args, iters=200):
-    out = fn(*args)
-    jax.block_until_ready(out)
+    # D2H-fenced (parallel/mesh.py settle): block_until_ready acks early
+    # through dev-chip tunnels and once reported pure dispatch latency here,
+    # making every impl look like "tens of microseconds" — an artifact that
+    # hid a 20x real difference between the corr-lookup impls
+    from video_features_tpu.parallel.mesh import settle
+    settle(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    settle(out)
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 

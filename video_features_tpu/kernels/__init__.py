@@ -18,20 +18,21 @@ None``; ``None`` reads the ``VFT_PALLAS`` env var (``1``/``0``), defaulting
 to pallas on TPU backends and XLA elsewhere (pallas interpret mode is used
 automatically on CPU so the kernels stay testable everywhere). The corr
 lookup is selected separately by ``VFT_CORR_LOOKUP`` in models/raft.py —
-``gather`` (default) | ``onehot`` | ``pallas``; both env vars are read at
-trace time, so set them before the first forward of the process.
+``pallas`` (TPU default) | ``onehot`` | ``gather`` (CPU default); both env
+vars are read at trace time, so set them before the first forward.
 
-Measured on TPU v5e (scripts/bench_kernels.py, f32, 200-iteration mean;
-everything here is tens of microseconds, so +-30% run-to-run noise):
+Measured on TPU v5e with a D2H-fenced timer (parallel/mesh.py settle;
+earlier microbenchmarks fenced with block_until_ready, which acks early
+through dev-chip tunnels and reported pure dispatch latency — those
+"everything is tens of microseconds" numbers were artifacts):
 
-  cost volume: pallas 2.2x faster than XLA on the two finest (dominant)
-    pyramid levels — (1,112,256,32): 0.012 vs 0.028 ms; (1,56,128,64):
-    0.011 vs 0.023 ms — the halo-DMA tile reads f2 from HBM once instead
-    of 81 shifted times; coarse levels are launch-bound and come out even.
-  corr lookup (jitted end-to-end): gather / one-hot / fused pallas are all
-    within noise of each other (14-37 us across B=1..8 shapes) — XLA's
-    lane-dim dynamic gather is already near-optimal, so RAFT keeps gather
-    as its default (models/raft.py) and the matmul forms stay alternates.
+  corr lookup, end-to-end 20-iteration RAFT forward (16 pairs @224px):
+    gather 4,097 ms / one-hot 331 ms / fused Pallas 200 ms. The 81-tap
+    4-corner scalar gathers are the worst access pattern the TPU has; the
+    MXU contraction forms win by 12-20x, so Pallas is the TPU default.
+  cost volume: sub-ms at every PWC level either way; the default follows
+    ``pallas_enabled()`` (Pallas on TPU, XLA elsewhere), overridable with
+    ``VFT_PALLAS=0/1`` or the wrapper's ``impl=`` argument.
 """
 from __future__ import annotations
 

@@ -209,17 +209,26 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
                 radius: int = CORR_RADIUS) -> jnp.ndarray:
     """Windowed bilinear lookup — implementation dispatcher.
 
-    ``VFT_CORR_LOOKUP`` selects ``gather`` (default), ``onehot`` or
-    ``pallas`` (kernels/corr_lookup.py). The env var is read at TRACE time:
-    it must be set before the first RAFT forward of the process — once the
-    jitted scan body is compiled, changing it has no effect (same caveat as
-    every static jit switch). Measured on TPU v5e (jitted, 46x46 grid,
-    B=1..8): all three are within measurement noise of each other (14-37
-    us) — XLA lowers the 4-corner take_along_axis to lane-dim dynamic
-    gathers which are near-bandwidth-optimal here, so gather stays the
-    default and the matmul formulations remain documented alternates."""
+    ``VFT_CORR_LOOKUP`` selects ``gather``, ``onehot`` or ``pallas``
+    (kernels/corr_lookup.py); unset picks ``pallas`` on TPU and ``gather``
+    elsewhere. The env var is read at TRACE time: it must be set before the
+    first RAFT forward of the process — once the jitted scan body is
+    compiled, changing it has no effect (same caveat as every static jit
+    switch).
+
+    Measured END-TO-END on TPU v5e with a D2H-fenced timer
+    (parallel/mesh.py settle — block_until_ready acks early through dev
+    tunnels and once made all impls look equal at ~20 us, a pure artifact):
+    full 20-iteration RAFT forward, 16 pairs @224px: gather 4,097 ms,
+    one-hot 331 ms, fused Pallas 200 ms. The scalar-indexed corner gathers
+    are a catastrophic access pattern for the TPU's vector memory; the
+    MXU contraction forms are 12-20x faster, so Pallas is the TPU default
+    and gather remains the parity/debug path (and the CPU default, where
+    XLA lowers it well)."""
     import os
-    impl = os.environ.get("VFT_CORR_LOOKUP", "gather").strip().lower()
+    impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
+    if not impl:
+        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
     if impl == "onehot":
         from ..kernels.corr_lookup import corr_lookup_onehot
         return corr_lookup_onehot(pyramid, coords, radius)

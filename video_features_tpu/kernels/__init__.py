@@ -14,12 +14,12 @@ are first-class TPU kernels:
     Pallas kernel and a pure-XLA twin.
 
 Dispatch: the cost-volume wrapper takes ``impl`` = ``'pallas' | 'xla' |
-None``; ``None`` reads the ``VFT_PALLAS`` env var (``1``/``0``), defaulting
-to pallas on TPU backends and XLA elsewhere (pallas interpret mode is used
-automatically on CPU so the kernels stay testable everywhere). The corr
-lookup is selected separately by ``VFT_CORR_LOOKUP`` in models/raft.py —
-``pallas`` (TPU default) | ``onehot`` | ``gather`` (CPU default); both env
-vars are read at trace time, so set them before the first forward.
+None``; ``None`` follows ``VFT_PALLAS`` (default: XLA everywhere — see
+:func:`pallas_enabled` for the hardware-fault rationale; interpret mode
+keeps the kernel testable on CPU). The corr lookup is selected separately
+by ``VFT_CORR_LOOKUP`` in models/raft.py — ``pallas`` (TPU default, the
+20x one) | ``onehot`` | ``gather`` (CPU default); both env vars are read
+at trace time, so set them before the first forward.
 
 Measured on TPU v5e with a D2H-fenced timer (parallel/mesh.py settle;
 earlier microbenchmarks fenced with block_until_ready, which acks early
@@ -30,9 +30,12 @@ through dev-chip tunnels and reported pure dispatch latency — those
     gather 4,097 ms / one-hot 331 ms / fused Pallas 200 ms. The 81-tap
     4-corner scalar gathers are the worst access pattern the TPU has; the
     MXU contraction forms win by 12-20x, so Pallas is the TPU default.
-  cost volume: sub-ms at every PWC level either way; the default follows
-    ``pallas_enabled()`` (Pallas on TPU, XLA elsewhere), overridable with
-    ``VFT_PALLAS=0/1`` or the wrapper's ``impl=`` argument.
+  cost volume (per call, fine levels): XLA 51 ms vs Pallas 45 ms at
+    (1,112,256,32); 15 vs 8 ms at (1,56,128,64) — Pallas modestly ahead
+    where it runs. But at un-128-aligned widths — PWC's coarse levels —
+    the Pallas kernel faults on real hardware (worker crash / Mosaic
+    compile error; interpret mode cannot catch it), so XLA is the default
+    and ``VFT_PALLAS=1`` is an explicit opt-in for aligned shapes.
 """
 from __future__ import annotations
 
@@ -42,13 +45,20 @@ import jax
 
 
 def pallas_enabled() -> bool:
-    """Static (trace-time) switch for pallas-vs-XLA kernel dispatch."""
+    """Static (trace-time) switch for the COST-VOLUME pallas-vs-XLA dispatch
+    (the corr lookup has its own dispatcher in models/raft.py).
+
+    Defaults to False everywhere: on real hardware the Pallas cost-volume
+    kernel faults (TPU worker crash, later a Mosaic compile error) at
+    un-128-aligned widths — exactly PWC's coarse pyramid levels — which
+    interpret-mode tests cannot catch. The XLA formulation is sub-ms at
+    every PWC shape, so it is the safe default; ``VFT_PALLAS=1`` opts in
+    explicitly (128-aligned shapes verified working on v5e).
+    """
     flag = os.environ.get("VFT_PALLAS", "").strip().lower()
     if flag in ("1", "true", "yes"):
         return True
-    if flag in ("0", "false", "no"):
-        return False
-    return jax.default_backend() == "tpu"
+    return False
 
 
 def interpret_mode() -> bool:

@@ -71,27 +71,31 @@ def cost_volume_pallas(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
     d = 2 * radius + 1
     th = min(tile_h, h)
     hp = -(-h // th) * th  # rows padded to a tile multiple; cropped after
+    # the f1/out width ALSO must be lane-aligned: an un-128-multiple W in
+    # the block shapes faults Mosaic on real hardware (observed as a TPU
+    # worker crash at W=64 — invisible in interpret mode)
+    wp = -(-w // 128) * 128
     f1t = jnp.moveaxis(f1, -1, 1)  # (B, C, H, W) channel-major
     f2t = jnp.moveaxis(f2, -1, 1)
-    f1t = jnp.pad(f1t, ((0, 0), (0, 0), (0, hp - h), (0, 0)))
+    f1t = jnp.pad(f1t, ((0, 0), (0, 0), (0, hp - h), (0, wp - w)))
     # the halo DMA slices f2p along rows only, so its lane (width) dim must
     # stay whole-and-tile-aligned for Mosaic: pad W+2r up to a 128 multiple
-    w2 = -(-(w + 2 * radius) // 128) * 128
+    w2 = -(-(wp + 2 * radius) // 128) * 128
     f2p = jnp.pad(f2t, ((0, 0), (0, 0),
                         (radius, radius + hp - h),
                         (radius, w2 - w - radius)))
     out = pl.pallas_call(
-        functools.partial(_kernel, th=th, radius=radius, w=w),
+        functools.partial(_kernel, th=th, radius=radius, w=wp),
         grid=(b, hp // th),
         in_specs=[
-            pl.BlockSpec((1, c, th, w), lambda bi, ti: (bi, 0, ti, 0),
+            pl.BlockSpec((1, c, th, wp), lambda bi, ti: (bi, 0, ti, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),  # f2p stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, d * d, th, w),
+        out_specs=pl.BlockSpec((1, d * d, th, wp),
                                lambda bi, ti: (bi, 0, ti, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, d * d, hp, w), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, d * d, hp, wp), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((c, th + 2 * radius, w2), f2p.dtype),
             pltpu.SemaphoreType.DMA,
@@ -99,7 +103,7 @@ def cost_volume_pallas(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
         interpret=interpret,
     )(f1t, f2p)
     # accumulate in f32, return the input dtype like the XLA twin does
-    return jnp.moveaxis(out[:, :, :h, :], 1, -1).astype(f1.dtype)
+    return jnp.moveaxis(out[:, :, :h, :w], 1, -1).astype(f1.dtype)
 
 
 def cost_volume(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,

@@ -1,0 +1,349 @@
+"""Golden-artifact parity against the reference's committed test refs.
+
+The reference ships recorded extraction outputs under
+``/root/reference/tests/<family>/reference/*.pt`` (format defined by
+reference tests/utils.py:36-45): each file stores ``{args, video_path,
+video_path_md5, data}`` where ``data`` is ONE output key's array — the
+feature array, the ``fps`` scalar, or the ``timestamps_ms`` vector — as
+produced by the original CUDA/torch stack on the real sample video. They pin
+exactly the windowing / fps-resampling / timestamp semantics this framework
+re-derived from source, and they are verifiable with zero model weights.
+
+Two tiers per recorded variant:
+
+  - **shape tier** (always runs): the real extractor pipeline executes with
+    the device forward replaced by a :func:`jax.eval_shape`-derived stub —
+    all decode, resampling, windowing, timestamp and ragged-batch bookkeeping
+    stays live at zero FLOPs. Asserts ``fps`` exactly, ``timestamps_ms``
+    allclose, and feature-array shape equality.
+  - **value tier** (runs when real checkpoints resolve via
+    ``weights.store.find_checkpoint``): full forward, feature values compared
+    under a cross-backend tolerance. Groups that fall back to the shape tier
+    are counted and reported by ``test_value_tier_coverage_report`` — never
+    silently skipped.
+
+The refs' ``args`` were saved as OmegaConf objects; omegaconf is not
+installed here, so they are unpickled with stub classes and flattened to
+plain dicts (no omegaconf code runs).
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+import shutil
+import types
+from contextlib import contextmanager
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REF_ROOT = "/root/reference/tests"
+SAMPLE = "/root/reference/sample/v_GGSY1Qvo990.mp4"
+
+# ---------------------------------------------------------------- ref loading
+
+
+class _OmegaStub:
+    """Placeholder for any pickled omegaconf class; holds raw state."""
+
+    def __init__(self, *a, **k):
+        pass
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self._state = state
+
+
+class _StubUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module.startswith("omegaconf"):
+            return type(name, (_OmegaStub,), {"__module__": module})
+        return super().find_class(module, name)
+
+
+_stub_pickle = types.ModuleType("golden_stub_pickle")
+_stub_pickle.Unpickler = _StubUnpickler
+_stub_pickle.load = pickle.load
+
+
+def _plain(x):
+    """omegaconf stub tree -> plain python (DictConfig._content/AnyNode._val)."""
+    if isinstance(x, _OmegaStub):
+        d = vars(x)
+        if "_content" in d:
+            return _plain(d["_content"])
+        if "_val" in d:
+            return _plain(d["_val"])
+        return {k: _plain(v) for k, v in d.items()}
+    if isinstance(x, dict):
+        return {k: _plain(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_plain(v) for v in x]
+    return x
+
+
+def _load_ref(path: str) -> dict:
+    d = torch.load(path, map_location="cpu", weights_only=False,
+                   pickle_module=_stub_pickle)
+    return {
+        "args": _plain(d["args"]),
+        "video_path": str(d["video_path"]),
+        "video_path_md5": d["video_path_md5"],
+        "data": np.asarray(d["data"]),
+    }
+
+
+#: output keys a ref filename can end with, longest first so that
+#: ``..._timestamps_ms.pt`` is not parsed as key ``ms``
+_KNOWN_KEYS = sorted(
+    ["timestamps_ms", "fps", "rgb", "flow",
+     "r21d", "s3d", "clip", "resnet", "raft", "pwc", "vggish"],
+    key=len, reverse=True)
+
+
+def _split_key(stem: str):
+    for key in _KNOWN_KEYS:
+        if stem.endswith("_" + key):
+            return stem[: -len(key) - 1], key
+    raise ValueError(f"Cannot parse output key from ref name {stem!r}")
+
+
+def _collect_groups():
+    """{(family, variant): {key: ref_path}} for every committed ref."""
+    groups = {}
+    for path in sorted(glob.glob(os.path.join(REF_ROOT, "*", "reference",
+                                              "*.pt"))):
+        family = Path(path).parent.parent.name
+        variant, key = _split_key(Path(path).stem)
+        groups.setdefault((family, variant), {})[key] = path
+    return groups
+
+
+GROUPS = _collect_groups()
+GROUP_IDS = [f"{fam}-{var}" for fam, var in GROUPS]
+
+# extractor config keys we replay from the recorded args (everything else —
+# device/paths/sinks — is environment, not semantics)
+_ARG_ALLOWLIST = (
+    "stack_size", "step_size", "streams", "flow_type", "extraction_fps",
+    "batch_size", "model_name", "side_size", "resize_to_smaller_edge",
+    "finetuned_on",
+)
+
+
+def _weight_keys(family: str, args: dict):
+    """model keys whose checkpoints enable the value tier for this variant."""
+    if family in ("resnet", "r21d"):
+        return [args["model_name"]]
+    if family == "s3d":
+        return ["s3d_kinetics400"]
+    if family == "clip":
+        return ["clip_" + str(args["model_name"]).replace("/", "-")]
+    if family == "raft":
+        return ["raft_" + str(args.get("finetuned_on") or "sintel")]
+    if family == "pwc":
+        return ["pwc_sintel"]
+    if family == "vggish":
+        return ["vggish"]
+    if family == "i3d":
+        streams = args.get("streams")
+        streams = ["rgb", "flow"] if streams in (None, "null") else [streams]
+        keys = [f"i3d_{s}" for s in streams]
+        if "flow" in streams:
+            flow = args.get("flow_type") or "raft"
+            keys.append("raft_sintel" if flow == "raft" else "pwc_sintel")
+        return keys
+    raise ValueError(family)
+
+
+def _value_tier_available(family: str, args: dict) -> bool:
+    from video_features_tpu.weights import store
+    return all(store.find_checkpoint(k) is not None
+               for k in _weight_keys(family, args))
+
+
+# ------------------------------------------------------------- forward stubs
+
+
+@contextmanager
+def _stub_forwards():
+    """Replace DataParallelApply's device execution with eval_shape zeros.
+
+    ``dispatch`` keeps its contract (padded rows, async-shaped output) and
+    ``__call__`` keeps its valid-row slicing, so every pipeline — including
+    the chained i3d flow->i3d handoff — runs its full host logic while the
+    jitted forwards never execute. Shapes come from ``jax.eval_shape`` on the
+    real jitted fn with the real params, so a model whose output dim drifted
+    would still fail the shape assertions.
+    """
+    import jax
+    from video_features_tpu.parallel import mesh as mesh_mod
+
+    cls = mesh_mod.DataParallelApply
+    orig_dispatch, orig_call = cls.dispatch, cls.__call__
+    shape_cache = {}
+
+    def _zeros(self, padded):
+        key = (id(self), padded.shape, str(padded.dtype))
+        if key not in shape_cache:
+            out = jax.eval_shape(
+                self._fn, self.params,
+                jax.ShapeDtypeStruct(padded.shape, padded.dtype))
+            shape_cache[key] = (out.shape, out.dtype)
+        shape, dtype = shape_cache[key]
+        return np.zeros(shape, dtype)
+
+    def dispatch(self, batch_np):
+        return _zeros(self, self._pad(batch_np))
+
+    def call(self, batch_np, n_valid=None):
+        n = batch_np.shape[0] if n_valid is None else n_valid
+        return dispatch(self, batch_np)[:n]
+
+    cls.dispatch, cls.__call__ = dispatch, call
+    try:
+        yield
+    finally:
+        cls.dispatch, cls.__call__ = orig_dispatch, orig_call
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _md5(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.md5(f.read()).hexdigest()
+
+
+@pytest.fixture(scope="session")
+def golden_sample():
+    if not GROUPS:
+        pytest.skip("reference mount has no committed golden refs")
+    if not os.path.exists(SAMPLE):
+        pytest.skip("reference sample video absent: golden refs record "
+                    "outputs for that exact file")
+    recorded = next(iter(GROUPS.values()))
+    any_ref = _load_ref(next(iter(recorded.values())))
+    if _md5(SAMPLE) != any_ref["video_path_md5"]:
+        pytest.skip("sample video md5 differs from the one the refs recorded")
+    return SAMPLE
+
+
+_RESULTS = {}  # (family, variant) -> (out_dict, value_tier: bool)
+_TIER_LOG = {}  # group id -> "value" | "shape"
+
+
+def _extract_group(family: str, variant: str, sample: str, tmp_root: Path):
+    key = (family, variant)
+    if key in _RESULTS:
+        return _RESULTS[key]
+
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    ref_args = _load_ref(next(iter(GROUPS[key].values())))["args"]
+    patch = {k: ref_args[k] for k in _ARG_ALLOWLIST if k in ref_args}
+    patch.update({
+        "video_paths": sample,
+        "device": "cpu",
+        "allow_random_weights": True,
+        "on_extraction": "print",
+        "output_path": str(tmp_root / family / variant / "out"),
+        "tmp_path": str(tmp_root / family / variant / "tmp"),
+    })
+    cfg = load_config(family, patch)
+    sanity_check(cfg)
+
+    value_tier = _value_tier_available(family, ref_args)
+    extractor = get_extractor_cls(family)(cfg)
+    if value_tier:
+        out = extractor.extract(sample)
+    else:
+        with _stub_forwards():
+            out = extractor.extract(sample)
+    _RESULTS[key] = (out, value_tier)
+    return _RESULTS[key]
+
+
+# --------------------------------------------------------------------- tests
+
+
+@pytest.mark.parametrize("group", list(GROUPS) if GROUPS else [],
+                         ids=GROUP_IDS)
+def test_golden_variant(group, golden_sample, tmp_path_factory):
+    family, variant = group
+    refs = {k: _load_ref(p) for k, p in GROUPS[group].items()}
+
+    if family == "vggish" and shutil.which("ffmpeg") is None:
+        pytest.skip("vggish golden needs the ffmpeg binary to rip the wav")
+
+    out, value_tier = _extract_group(
+        family, variant, golden_sample,
+        tmp_path_factory.mktemp("golden"))
+    _TIER_LOG[f"{family}-{variant}"] = "value" if value_tier else "shape"
+
+    for key, ref in refs.items():
+        want = ref["data"]
+        assert key in out, f"extractor output is missing key {key!r}"
+        got = np.asarray(out[key])
+
+        if key == "fps":
+            # recorded via the same cv2 metadata read — must match exactly
+            np.testing.assert_allclose(got, want, rtol=0, atol=1e-9,
+                                       err_msg=f"{family}/{variant}: fps")
+            continue
+        if key == "timestamps_ms":
+            assert got.shape == want.shape, (
+                f"{family}/{variant}: {got.shape[0]} timestamps vs recorded "
+                f"{want.shape[0]} — frame selection/windowing diverged")
+            np.testing.assert_allclose(
+                got, want, rtol=1e-9, atol=1e-6,
+                err_msg=f"{family}/{variant}: timestamps_ms")
+            continue
+
+        # feature arrays: shape always; values only with real weights
+        assert got.shape == tuple(want.shape), (
+            f"{family}/{variant}: feature {key!r} shape {got.shape} vs "
+            f"recorded {tuple(want.shape)}")
+        if value_tier:
+            np.testing.assert_allclose(
+                got.astype(np.float64), want.astype(np.float64),
+                atol=1e-2, rtol=1e-3,
+                err_msg=f"{family}/{variant}: feature {key!r} values "
+                        "(cross-backend tolerance)")
+
+    # internal consistency the refs can't see but the contract implies:
+    # frame-wise features carry one row per timestamp; flow families read n
+    # frames (n timestamps) and emit n-1 pairwise flows (reference
+    # base_flow_extractor.py:77-95)
+    if family in ("resnet", "clip") and family in out:
+        assert out[family].shape[0] == out["timestamps_ms"].shape[0]
+    if family in ("raft", "pwc") and family in out:
+        assert out[family].shape[0] == out["timestamps_ms"].shape[0] - 1
+
+
+def test_value_tier_coverage_report():
+    """Explicit accounting of which variants got value-level verification.
+
+    The value tier needs real pretrained checkpoints, which this environment
+    cannot fetch (no egress; reference blobs absent per .MISSING_LARGE_BLOBS).
+    This test makes that visible instead of letting skips hide it.
+    """
+    if not _TIER_LOG:
+        pytest.skip("no golden variants ran")
+    shape_only = sorted(g for g, t in _TIER_LOG.items() if t == "shape")
+    value = sorted(g for g, t in _TIER_LOG.items() if t == "value")
+    print(f"\ngolden refs: {len(value)} value-verified, "
+          f"{len(shape_only)} shape/fps/timestamps-verified (no weights)")
+    for g in value:
+        print(f"  value: {g}")
+    for g in shape_only:
+        print(f"  shape: {g}")
+    assert _TIER_LOG, "golden harness ran no variants"

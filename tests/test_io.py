@@ -76,12 +76,16 @@ def test_fps_filter_map_properties():
     # fps filter), monotonic
     m = fps_filter_map(100, 30.0, 10.0)
     assert np.array_equal(m[:-1], 3 * np.arange(len(m) - 1) + 1)
-    assert m[-1] == 99  # input ends before the final slot's preferred frame
+    # the stream ends at EOF pts (num_frames/src_fps): exactly
+    # round(100 * 10/30) = 33 output frames, and trailing inputs whose slot
+    # lands past that cutoff are dropped (golden-pinned in test_golden.py:
+    # the real binary emits 54 frames at fps=3, not 55)
+    assert len(m) == 33
+    assert m[-1] == 97
     assert np.all(np.diff(m) >= 0)
-    assert len(m) == pytest.approx(34, abs=1)
-    # upsample duplicates frames
+    # upsample duplicates frames up to the EOF cutoff: round(10 * 2) = 20
     m2 = fps_filter_map(10, 10.0, 20.0)
-    assert len(m2) == pytest.approx(19, abs=1)
+    assert len(m2) == 20
     assert np.all(np.diff(m2) <= 1)
     # identity
     m3 = fps_filter_map(50, 25.0, 25.0)

@@ -6,7 +6,10 @@ frames; the rgb stream also uses ``stack[:-1]`` so both streams have equal
 feature length, extract_i3d.py:148-159), runs each stream's I3D on
 center-cropped 224 inputs scaled to [-1, 1], and records one
 ``timestamps_ms`` entry per completed stack = the POS_MSEC after the last
-read frame, i.e. ``(last_idx + 1) / fps * 1000`` (extract_i3d.py:122).
+read frame, i.e. the pts of the frame just decoded:
+``last_idx / fps * 1000`` (extract_i3d.py:122; cv2's ffmpeg backend reports
+the decoded frame's own pts, pinned by the recorded golden refs in
+tests/test_golden.py — a next-frame ``last_idx + 1`` rule is one frame off).
 
 Re-design for TPU: frames are kept uint8 on host (PIL resize output;
 ``ToFloat`` only changes dtype so this is lossless), stacks are grouped into
@@ -200,8 +203,9 @@ class ExtractI3D(BaseExtractor):
             frames.append(frame)
             if len(frames) - 1 == self.stack_size:
                 stacks.append(np.stack(frames))
-                # POS_MSEC after the last read frame (extract_i3d.py:122)
-                timestamps_ms.append((idx + 1) / src.fps * 1000.0)
+                # POS_MSEC = pts of the last read frame (extract_i3d.py:122;
+                # golden-pinned in tests/test_golden.py)
+                timestamps_ms.append(idx / src.fps * 1000.0)
                 frames = frames[self.step_size:]
                 if len(stacks) == self.clip_batch_size:
                     flush()

@@ -74,19 +74,33 @@ def fps_filter_map(num_frames: int, src_fps: float, dst_fps: float) -> np.ndarra
     ffmpeg's fps filter (round=near) assigns each input frame i (pts i/src_fps)
     the output slot ``round(i * dst_fps / src_fps)`` and fills every output
     slot with the latest input frame whose slot <= it (duplicating to fill
-    gaps, dropping when several inputs collapse onto one slot). Returns an
-    int array `m` of length n_out with out[k] = src[m[k]]; m is monotonic.
+    gaps, dropping when several inputs collapse onto one slot). The stream
+    ends at the EOF timestamp ``num_frames / src_fps`` (last pts + frame
+    duration), so the filter emits exactly
+    ``round(num_frames * dst_fps / src_fps)`` frames — a final input frame
+    whose own slot lands past that cutoff is dropped, and on upsampling the
+    last frame duplicates up to it. Verified against outputs recorded from
+    the real binary: the golden refs pin 54 frames at fps=3 and 18 at fps=1
+    for the 355-frame 19.62-fps sample (tests/test_golden.py), where the
+    naive ``last slot + 1`` rule would emit one extra frame.
+
+    Returns an int array `m` of length n_out with out[k] = src[m[k]];
+    m is monotonic.
     """
     if num_frames <= 0:
         return np.zeros((0,), dtype=np.int64)
+    r = dst_fps / src_fps
     i = np.arange(num_frames, dtype=np.float64)
     # half-away-from-zero rounding (ffmpeg AV_ROUND_NEAR_INF), NOT np.round's
     # banker's rounding: at an exact 2x downsample the two differ and banker's
     # rounding would select temporally non-uniform frames
-    slots = np.floor(i * (dst_fps / src_fps) + 0.5).astype(np.int64)
-    n_out = int(slots[-1]) + 1
+    slots = np.floor(i * r + 0.5).astype(np.int64)
+    # one guarded frame minimum: a video short enough to round to zero output
+    # frames would otherwise produce an empty stream downstream
+    n_out = max(int(np.floor(num_frames * r + 0.5)), 1)
     mapping = np.zeros((n_out,), dtype=np.int64)
-    # latest input frame per slot wins; forward-fill gaps
+    # latest input frame per slot wins; forward-fill gaps; slots at or past
+    # the EOF cutoff are dropped with their frames
     last = 0
     src_of_slot = {}
     for idx, s in enumerate(slots):

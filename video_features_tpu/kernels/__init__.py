@@ -48,12 +48,16 @@ def pallas_enabled() -> bool:
     """Static (trace-time) switch for the COST-VOLUME pallas-vs-XLA dispatch
     (the corr lookup has its own dispatcher in models/raft.py).
 
-    Defaults to False everywhere: on real hardware the Pallas cost-volume
-    kernel faults (TPU worker crash, later a Mosaic compile error) at
-    un-128-aligned widths — exactly PWC's coarse pyramid levels — which
-    interpret-mode tests cannot catch. The XLA formulation is sub-ms at
-    every PWC shape, so it is the safe default; ``VFT_PALLAS=1`` opts in
-    explicitly (128-aligned shapes verified working on v5e).
+    Defaults to False ON MEASUREMENT, not fear: after the round-2 lane
+    (W->128) and sublane (H->8) padding fixes, ``cost_volume_pallas`` is
+    hardware-validated CLEAN on every real PWC pyramid shape (15 shapes, 3
+    input geometries x 5 decoder levels, odd/tiny sizes included; parity
+    <3e-7 vs the XLA twin). Timed best-of-3 on v5e it is within noise of the
+    XLA formulation overall — ahead at the tiny coarse levels (1.7x at
+    4x5xC196), behind at the large ones (0.7-0.9x at /4 and /8) where XLA's
+    fusion wins. The XLA twin therefore stays the default; ``VFT_PALLAS=1``
+    opts in (useful as the starting point if the cost volume ever needs to
+    fuse with the warp that feeds it).
     """
     flag = os.environ.get("VFT_PALLAS", "").strip().lower()
     if flag in ("1", "true", "yes"):

@@ -69,8 +69,13 @@ def cost_volume_pallas(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
                        tile_h: int = 32) -> jnp.ndarray:
     b, h, w, c = f1.shape
     d = 2 * radius + 1
-    th = min(tile_h, h)
-    hp = -(-h // th) * th  # rows padded to a tile multiple; cropped after
+    # rows pad to an 8-SUBLANE multiple before tiling: PWC's coarse pyramid
+    # levels have h in {2..14}, and a block sublane dim that is not a
+    # multiple of 8 faults Mosaic on real hardware (hardware-validated
+    # across every real pyramid shape; invisible in interpret mode)
+    h8 = -(-h // 8) * 8
+    th = min(tile_h, h8)
+    hp = -(-h8 // th) * th  # then to a tile multiple; cropped after
     # the f1/out width ALSO must be lane-aligned: an un-128-multiple W in
     # the block shapes faults Mosaic on real hardware (observed as a TPU
     # worker crash at W=64 — invisible in interpret mode)

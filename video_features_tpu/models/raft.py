@@ -224,7 +224,14 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
     are a catastrophic access pattern for the TPU's vector memory; the
     MXU contraction forms are 12-20x faster, so Pallas is the TPU default
     and gather remains the parity/debug path (and the CPU default, where
-    XLA lowers it well)."""
+    XLA lowers it well).
+
+    Hardware-smoked across resolutions (scripts/validate_kernels_tpu.py):
+    no Mosaic faults at any pyramid width 8..42 (odd/small included), and
+    pallas == onehot exactly with both ~1e-5 from gather under the
+    extractors' precision=float32 matmul-precision pin. Under
+    precision=bfloat16 the contraction legitimately drifts ~8e-3 (MXU
+    bf16), which is that mode's contract."""
     import os
     impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
     if not impl:

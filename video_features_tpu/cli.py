@@ -67,6 +67,18 @@ def main(argv: Optional[List[str]] = None) -> None:
         # the backend and would lock process_count() at 1. After this,
         # jax.process_index()/process_count() drive local_shard_of_list.
         import jax
+        if str(args.get("device", "")) == "cpu":
+            # explicit device=cpu must hold through distributed init: some
+            # hosts' sitecustomize re-points jax at an accelerator plugin
+            # after env vars are read (same hard-pin as extractors/base.py),
+            # and a CPU cluster needs the gloo cross-process collectives
+            # client for process_count()/process_index() to reflect the job
+            jax.config.update("jax_platforms", "cpu")
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except (AttributeError, ValueError):
+                pass  # older/newer jax without the knob: fine for TPU pods
         # tolerate in-process re-runs; is_initialized is absent on older jax,
         # where the double-init RuntimeError is caught instead
         already = getattr(jax.distributed, "is_initialized", lambda: False)

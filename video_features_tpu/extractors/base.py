@@ -38,6 +38,20 @@ class BaseExtractor:
         self.show_pred = bool(args.get("show_pred", False))
         self.args = args
 
+    def _data_mesh(self):
+        """Device mesh for this extractor's runners.
+
+        ``mesh_devices`` (config) pins the width explicitly — how tests and
+        the driver dryrun shard real extractors over the virtual CPU mesh.
+        Default: all local devices on TPU; one on CPU (a single-core host
+        gains nothing from virtual sharding, and an explicit ``device=cpu``
+        run must not enumerate the TPU)."""
+        from ..parallel.mesh import get_mesh
+        n = self.args.get("mesh_devices")
+        if n is not None:
+            return get_mesh(n_devices=int(n))
+        return get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+
     def feature_stream(self, runner, depth: int = 4, on_result=None):
         """Async dispatch stream over ``runner`` (parallel/mesh.py
         FeatureStream). When show_pred needs per-batch host values, the

@@ -67,7 +67,7 @@ class ExtractI3D(BaseExtractor):
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         self._dtype = dtype
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         self.model = i3d_model.I3D(num_classes=400)
         self.runners: Dict[str, DataParallelApply] = {}
         self.logits_runners: Dict[str, DataParallelApply] = {}

@@ -67,8 +67,11 @@ class FlowStream:
         crop = parent.central_crop_size
         if parent.flow_type == "raft":
             # the reference hardcodes the sintel checkpoint for the i3d flow
-            # sub-model (extract_i3d.py:178)
-            flow_model = raft_model.RAFT(iters=raft_model.ITERS)
+            # sub-model (extract_i3d.py:178); flow_iters trades flow accuracy
+            # for speed (fewer GRU refinement steps) — default is the
+            # reference's fixed 20 (raft.py:118)
+            iters = int(args.get("flow_iters") or raft_model.ITERS)
+            flow_model = raft_model.RAFT(iters=iters)
             flow_params = store.resolve_params(
                 "raft_sintel", raft_model.init_params,
                 raft_model.params_from_torch,

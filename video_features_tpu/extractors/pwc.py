@@ -36,6 +36,6 @@ class ExtractPWC(OpticalFlowExtractor):
             "pwc_sintel", pwc_model.init_params, pwc_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         self._init_flow_runner(partial(_pwc_forward, self.model), params,
                                mesh)

@@ -71,7 +71,7 @@ class ExtractR21D(ClipStackExtractor):
         self.head_params = params["head"]
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         fwd = (_device_forward_yuv420 if self.ingest == "yuv420"
                else _device_forward)
         self.runner = DataParallelApply(

@@ -35,12 +35,15 @@ class ExtractRAFT(OpticalFlowExtractor):
             raise NotImplementedError(
                 f"finetuned_on={finetuned_on!r}; reference supports "
                 "sintel/kitti (extract_raft.py:6-9)")
-        self.model = raft_model.RAFT(iters=raft_model.ITERS)
+        # iters trades flow accuracy for speed (fewer GRU refinement steps);
+        # default is the reference's fixed 20 (raft.py:118)
+        self.model = raft_model.RAFT(
+            iters=int(args.get("iters") or raft_model.ITERS))
         params = store.resolve_params(
             f"raft_{finetuned_on}", raft_model.init_params,
             raft_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         self._init_flow_runner(partial(_raft_forward, self.model), params,
                                mesh)

@@ -56,7 +56,7 @@ class ExtractResNet(FrameWiseExtractor):
         self.head_params = params["head"]
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         uint8_fwd = partial(_device_forward, self.model, dtype)
         fwd = (partial(_device_forward_yuv420, self.model, dtype)
                if self.ingest == "yuv420" else uint8_fwd)

@@ -58,7 +58,7 @@ class ExtractS3D(ClipStackExtractor):
             allow_random=bool(args.get("allow_random_weights", False)))
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         # cast once for both runners
         params = cast_floating(params, dtype)
         fwd = (_device_forward_yuv420 if self.ingest == "yuv420"

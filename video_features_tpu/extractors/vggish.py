@@ -63,7 +63,7 @@ class ExtractVGGish(BaseExtractor):
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        mesh = get_mesh(n_devices=1) if self.device == "cpu" else get_mesh()
+        mesh = self._data_mesh()
         self.frontend = args.get("frontend") or "host"
         if self.frontend not in ("host", "device"):
             raise NotImplementedError(f"frontend={self.frontend!r}")

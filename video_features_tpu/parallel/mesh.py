@@ -38,8 +38,17 @@ def get_mesh(n_devices: Optional[int] = None,
 
     ``backend`` pins the platform (e.g. ``"cpu"``) — an explicit
     ``device=cpu`` run must never enumerate (and thereby claim) the TPU.
+
+    Uses *addressable* devices on purpose: under ``jax.distributed`` each
+    process runs its own data-parallel mesh over its own chips (extraction
+    is embarrassingly parallel at clip granularity — the only multi-host
+    coordination is the work-list shard, :func:`local_shard_of_list`). A
+    global-device mesh here would make every ``device_put`` of host frames
+    target other hosts' chips and fail. Single-process runs are unaffected
+    (local == global).
     """
-    devs = jax.devices(backend) if backend else jax.devices()
+    devs = jax.local_devices(backend=backend) if backend \
+        else jax.local_devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     if shape is None:

@@ -1,126 +1,36 @@
 #!/usr/bin/env python
-"""Benchmark: I3D RGB+Flow (RAFT) two-stream stack throughput on the chip.
+"""Standalone I3D RGB+Flow (RAFT) stack-throughput benchmark.
 
-The second north-star config (BASELINE.md: "clips/sec/chip for R(2+1)D and
-I3D-RGB+Flow"). Prints one JSON line in the same shape as bench.py:
+Since round 2 the I3D RGB+Flow config is part of the driver-run headline
+benchmark (bench.py emits both north-star metrics); this wrapper stays for
+ad-hoc runs at non-default stack sizes, e.g.::
 
-  {"metric": ..., "value": N, "unit": "stacks/sec/chip", "vs_baseline": N}
+    python scripts/bench_i3d.py          # full 64-frame reference stacks
+    python scripts/bench_i3d.py 16       # quicker 16-frame probe
 
-One "stack" is the reference's unit of work for I3D (extract_i3d.py:140-169):
-64+1 RGB frames at 224px -> RAFT flow on the 64 consecutive pairs (20 GRU
-iterations each) -> quantize (ToUInt8 path) -> I3D-RGB and I3D-Flow forwards.
-The baseline is the same composition in torch on this host's CPU (the
-reference engine's serial path); ``vs_baseline`` is ours/theirs.
-
-bench.py remains the driver-run headline; this script records the heavier
-composed config. Run on TPU (no JAX_PLATFORMS override).
+Prints one JSON line in the bench.py metric shape. Run on TPU (no
+JAX_PLATFORMS override).
 """
 import json
 import sys
-import time
 from pathlib import Path
-
-import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-STACK = 16          # frames per stack (full reference default is 64)
-SIDE = 224
-WARMUP = 3
-ITERS = 10
-TRIALS = 3  # best-of, same policy as bench.py
-
-
-def bench_ours() -> float:
-    import jax
-    import jax.numpy as jnp
-    if jax.default_backend() != "cpu":
-        # persistent compile cache (safe off-CPU — see cli.py): the RAFT
-        # 20-iteration scan costs tens of minutes of XLA compile cold
-        from video_features_tpu.cli import _enable_compilation_cache
-        _enable_compilation_cache({"device": "auto"})
-    from video_features_tpu.extractors.i3d import _i3d_forward
-    from video_features_tpu.extractors.i3d_flow import _raft_quantized_flow
-    from video_features_tpu.models import i3d as i3d_m, raft as raft_m
-    from video_features_tpu.parallel.mesh import cast_floating
-
-    model = i3d_m.I3D(num_classes=400)
-    raft = raft_m.RAFT(iters=raft_m.ITERS)
-    i3d_rgb = cast_floating(i3d_m.init_params("rgb"), jnp.bfloat16)
-    i3d_flow = cast_floating(i3d_m.init_params("flow"), jnp.bfloat16)
-    raft_p = raft_m.init_params()
-
-    @jax.jit
-    def step(rp, pr, pf, stack_u8):
-        # stack_u8: (STACK+1, H, W, 3) uint8 — the extractor's own device
-        # functions composed exactly like ExtractI3D.run_on_a_stack
-        pairs = jnp.stack([stack_u8[:-1], stack_u8[1:]], axis=1)
-        quant = _raft_quantized_flow(raft, SIDE, rp, pairs)   # (STACK,S,S,2)
-        rgb_feat = _i3d_forward(model, jnp.bfloat16, True, pr,
-                                stack_u8[:-1][None].astype(jnp.float32))
-        flow_feat = _i3d_forward(model, jnp.bfloat16, True, pf, quant[None])
-        return rgb_feat, flow_feat
-
-    rng = np.random.default_rng(0)
-    # device-resident inputs + D2H settle fence: see bench.py's measurement
-    # notes (host-fed dispatch measures the tunnel; block_until_ready can
-    # ack early)
-    stacks = [jax.device_put(rng.integers(0, 255,
-                                          size=(STACK + 1, SIDE, SIDE, 3),
-                                          dtype=np.uint8)) for _ in range(2)]
-    from video_features_tpu.parallel.mesh import settle
-    settle(step(raft_p, i3d_rgb, i3d_flow, stacks[0]))
-    for _ in range(WARMUP):
-        settle(step(raft_p, i3d_rgb, i3d_flow, stacks[1]))
-    best = 0.0
-    for _ in range(TRIALS):  # best-of: transient tenancy stalls
-        t0 = time.perf_counter()
-        for i in range(ITERS):
-            out = step(raft_p, i3d_rgb, i3d_flow, stacks[i % 2])
-        settle(out)
-        best = max(best, ITERS / (time.perf_counter() - t0))
-    return best
-
-
-def bench_torch_reference() -> float:
-    """Reference-shaped composition in torch on this host's CPU: RAFT flow
-    (imported read-only from /root/reference) is the dominant cost; absent
-    that source, fall back to the I3D-RGB-only composition."""
-    import importlib.util
-    import torch
-
-    ref_raft_dir = Path("/root/reference/models/raft/raft_src")
-    if not ref_raft_dir.exists():
-        return float("nan")
-    # reference raft.py imports via the 'models.raft.raft_src' package path,
-    # so the reference ROOT goes on sys.path (same as tests/test_raft.py)
-    if "/root/reference" not in sys.path:
-        sys.path.insert(0, "/root/reference")
-    spec = importlib.util.spec_from_file_location(
-        "ref_raft", ref_raft_dir / "raft.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-
-    raft = mod.RAFT().eval()  # reference RAFT takes no args (raft.py:54)
-    x = torch.randint(0, 255, (STACK, 3, SIDE, SIDE), dtype=torch.float32)
-    with torch.no_grad():
-        raft(x[:1], x[:1], iters=2)  # warmup/compile
-        t0 = time.perf_counter()
-        raft(x[:4], x[:4], iters=20, test_mode=True)
-        dt = (time.perf_counter() - t0) * (STACK / 4)  # scale to full stack
-    return 1.0 / dt  # flow alone already dominates the torch stack time
+from bench import I3D_SIDE, bench_i3d_ours, bench_i3d_torch  # noqa: E402
 
 
 def main() -> None:
-    ours = bench_ours()
+    stack = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    ours = bench_i3d_ours(stack=stack)
     try:
-        theirs = bench_torch_reference()
+        theirs = bench_i3d_torch(stack=stack)
         ratio = ours / theirs if theirs == theirs else None
     except Exception:
         ratio = None
     import jax
     print(json.dumps({
-        "metric": f"i3d rgb+flow(raft) {STACK}f@{SIDE}px stack throughput "
+        "metric": f"i3d rgb+flow(raft) {stack}f@{I3D_SIDE}px stack throughput "
                   f"({jax.devices()[0].platform}, bf16 i3d / f32 raft)",
         "value": round(ours, 3),
         "unit": "stacks/sec/chip",

@@ -13,13 +13,20 @@ Usage (any main.py key=value passes through):
     python scripts/throughput.py feature_type=resnet model_name=resnet18 \
         device=cpu extraction_fps=8 resize=device --repeat 4
 
-    # compare two knob sets on the same inputs
+    # A/B: the keys before the first '::' run as the baseline config, then
+    # each '::'-separated override group runs merged on top of it
+    # (parse_dotlist is last-wins, so an override may redefine a baseline
+    # key). This prints 2 lines: [resize=host], [resize=device]:
     python scripts/throughput.py feature_type=r21d --repeat 4 -- \
         resize=host :: resize=device
 
 Prints one JSON line per knob set:
     {"config": ..., "videos": N, "seconds": S, "videos_per_s": ...,
      "frames_per_s": ...}
+
+Each config gets an UNTIMED single-video warmup pass before its timed run
+(weight load, page cache, jit compiles), so ordering does not bias the
+comparison toward later variants.
 
 The sample video (/root/reference/sample/*.mp4 when present) is copied
 ``--repeat`` times under distinct stems so the idempotent skip never
@@ -41,6 +48,13 @@ SAMPLE = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
 def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
     from video_features_tpu.cli import main as cli_main
     out = workdir / f"out_{tag}"
+    # untimed warmup: one video into a throwaway dir, so this config pays its
+    # own weight-loading/page-cache/compile costs before the clock starts
+    # (otherwise whichever config runs first subsidizes the rest)
+    cli_main(list(base_args) + [
+        "on_extraction=save_numpy", f"output_path={workdir / f'warm_{tag}'}",
+        f"tmp_path={workdir / 'tmp'}", f"video_paths=[{videos[0]}]",
+    ])
     args = list(base_args) + [
         "on_extraction=save_numpy", f"output_path={out}",
         f"tmp_path={workdir / 'tmp'}",
@@ -85,8 +99,10 @@ def main() -> None:
         raise SystemExit(f"unrecognized arguments: {bad} "
                          "(expected key=value, '::', --repeat, --video)")
     if "::" in rest:
-        # args before the first '::' are common; each '::'-separated tail
-        # group is one variant compared on the same inputs
+        # args before the first '::' are the baseline config; it runs AS the
+        # first variant, and each '::'-separated group runs merged on top of
+        # it (parse_dotlist last-wins lets a group override a baseline key) —
+        # so `resize=host :: resize=device` really compares host vs device
         idx = rest.index("::")
         common, groups, cur = rest[:idx], [], []
         for a in rest[idx + 1:]:
@@ -96,7 +112,9 @@ def main() -> None:
             else:
                 cur.append(a)
         groups.append(cur)
-        configs = [common + g for g in groups]
+        # a leading '::' (no shared baseline) just runs the groups
+        configs = ([common] if common else []) + \
+                  [common + g for g in groups if g]
     else:
         configs = [rest]
 

@@ -51,13 +51,25 @@ def _synthesize_sample(path: str) -> str:
     return path
 
 
+#: committed copy of the synthesized stand-in (same nominal properties as
+#: the reference sample), so the repo is test-self-contained without the
+#: mount and without an encode-capable cv2 at test time
+VENDORED_SAMPLE = os.path.join(os.path.dirname(__file__), "assets",
+                               "v_synth_sample.mp4")
+
+
 @pytest.fixture(scope="session")
 def sample_video(tmp_path_factory):
     # VFT_FORCE_SYNTH_SAMPLE=1 exercises the synthesis path even when the
-    # reference mount exists (how the fallback itself is validated)
+    # reference mount / vendored clip exists (validates the fallback itself)
     force = os.environ.get("VFT_FORCE_SYNTH_SAMPLE", "") not in ("", "0")
-    if os.path.exists(SAMPLE_VIDEO) and not force:
+    if force:
+        return _synthesize_sample(
+            str(tmp_path_factory.mktemp("sample") / "v_synth_sample.mp4"))
+    if os.path.exists(SAMPLE_VIDEO):
         return SAMPLE_VIDEO
+    if os.path.exists(VENDORED_SAMPLE):
+        return VENDORED_SAMPLE
     if os.environ.get("VFT_NO_SYNTH_SAMPLE"):
         pytest.skip("reference sample video not available")
     return _synthesize_sample(

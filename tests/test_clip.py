@@ -193,8 +193,9 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     sanity_check(cfg)
     ex = ExtractCLIP(cfg)
     feats = ex._extract(sample_video)
-    # ~18.1s @2fps = 37 frames, ViT-B/32 -> 512-d
-    assert feats["clip"].shape == (37, 512)
-    assert feats["timestamps_ms"].shape == (37,)
+    # 355 frames @2fps = round(355*2/19.62) = 36 frames (ffmpeg EOF rule,
+    # golden-pinned in test_golden.py), ViT-B/32 -> 512-d
+    assert feats["clip"].shape == (36, 512)
+    assert feats["timestamps_ms"].shape == (36,)
     out_dir = tmp_path / "out" / "clip" / "ViT-B_32"
     assert (out_dir / f"{Path(sample_video).stem}_clip.npy").exists()

@@ -148,9 +148,9 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     sanity_check(cfg)
     ex = ExtractPWC(cfg)
     feats = ex._extract(sample_video)
-    # ~18.1s @1fps = 19 frames -> 18 pairs; larger-edge resize 112 on
-    # 320x240 -> 112x84
+    # 355 frames @1fps = round(355/19.62) = 18 frames (ffmpeg EOF rule,
+    # golden-pinned) -> 17 pairs; larger-edge resize 112 on 320x240 -> 112x84
     n, c, h, w = feats["pwc"].shape
     assert (c, h, w) == (2, 84, 112)
-    assert n == 18 and len(feats["timestamps_ms"]) == 19
+    assert n == 17 and len(feats["timestamps_ms"]) == 18
     assert (tmp_path / "out" / "pwc" / f"{Path(sample_video).stem}_pwc.npy").exists()

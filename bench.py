@@ -15,7 +15,7 @@ this host's CPU exactly like the reference's serial per-slice loops.
 
 R(2+1)D config: steady-state jitted forward, maximum-throughput ingest
 (``ingest=yuv420``: packed I420 uint8 clips, 1.5 bytes/pixel, colorspace
-fused on device — ops/colorspace.py), bfloat16, B=64 clips per step.
+fused on device — ops/colorspace.py), bfloat16, B=128 clips per step.
 
 I3D config: the full reference work unit (extract_i3d.py:140-169) — 64+1 RGB
 frames at 224px -> RAFT flow on 64 consecutive pairs (20 GRU iterations
@@ -43,7 +43,10 @@ import time
 import numpy as np
 
 CLIP = (16, 112, 112, 3)  # stack, H, W, C
-BATCH = 64  # measured sweet spot on v5e: ~15% over B=16, B=128 flat, B=256 regresses
+# measured sweet spot on v5e for the current yuv420+bf16 program (round-2
+# sweep): 64 -> 972, 96 -> 1144, 128 -> 1471, 192 -> 1136 (tiling dip),
+# 256 -> 1429 clips/s. The round-1 "B=128 flat" note predates this program.
+BATCH = 128
 I3D_STACK = 64      # the reference's default stack (BASELINE.json flagship)
 I3D_SIDE = 224
 WARMUP = 5

@@ -156,3 +156,22 @@ def test_raft_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
     err = np.abs(a - b)
     assert np.median(err) < 0.1 and np.percentile(err, 99) < 1.0, \
         (np.median(err), np.percentile(err, 99))
+
+
+def test_iters_config_knob(tmp_path):
+    """`iters` (raft) / `flow_iters` (i3d) expose the GRU refinement count
+    the reference hardcodes at 20 (raft.py:118); default stays 20."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    def build(**patch):
+        args = load_config("raft", dict(
+            {"feature_type": "raft", "device": "cpu", "batch_size": 1,
+             "allow_random_weights": True, "video_paths": "x.mp4",
+             "output_path": str(tmp_path / "o"),
+             "tmp_path": str(tmp_path / "t")}, **patch))
+        sanity_check(args)
+        return get_extractor_cls("raft")(args)
+
+    assert build().model.iters == 20
+    assert build(iters=2).model.iters == 2

@@ -30,7 +30,7 @@ Two implementations with identical numerics:
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -87,9 +87,12 @@ def corr_lookup_onehot(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
 def _level_kernel(px0_ref, py0_ref, corr_ref, out_ref, *, radius: int):
     """Block shapes: px0/py0 (1, TP, 1, 1) — pre-expanded on the host so no
     rank-changing relayout happens in-kernel (Mosaic rejects 1D->3D
-    reshapes); corr (1, TP, Hl, Wl); out (1, TP, n, n) with out[., p, xx, yy]
-    = tap (x-offset xx, y-offset yy), i.e. already in the reference's
-    x-slowest order once the host collapses the last two dims."""
+    reshapes); corr (1, TP, Hl, Wl); out (1, TP, n*n) with tap channel
+    k = xx*n + yy (x-offset slowest — the reference's order). The flatten
+    happens IN-kernel as a lane concat of the n sublane rows: emitting
+    (TP, n, n) and reshaping on the host instead costs a full extra HBM
+    pass per level per GRU iteration (measured ~43 ms per 64-pair RAFT
+    forward, re-laying (9,9)-minor tiles into dense lanes)."""
     n = 2 * radius + 1
     tp, hl, wl = corr_ref.shape[1:]
     px0 = px0_ref[0]  # (TP, 1, 1)
@@ -117,10 +120,58 @@ def _level_kernel(px0_ref, py0_ref, corr_ref, out_ref, *, radius: int):
         preferred_element_type=jnp.float32)
     fx = px0 - ix  # (TP, 1, 1), broadcasts over the window dims
     fy = py0 - iy
-    out_ref[0] = ((1 - fx) * (1 - fy) * window[:, :n, :n]
-                  + fx * (1 - fy) * window[:, 1:, :n]
-                  + (1 - fx) * fy * window[:, :n, 1:]
-                  + fx * fy * window[:, 1:, 1:])
+    blended = ((1 - fx) * (1 - fy) * window[:, :n, :n]
+               + fx * (1 - fy) * window[:, 1:, :n]
+               + (1 - fx) * fy * window[:, :n, 1:]
+               + fx * fy * window[:, 1:, 1:])  # (TP, n_x, n_y)
+    for i in range(n):  # static lane-sliced stores: row i -> taps [i*n, i*n+n)
+        out_ref[0, :, i * n:(i + 1) * n] = blended[:, i, :]
+
+
+def align_level(corr: jnp.ndarray) -> jnp.ndarray:
+    """Zero-pad a (B, P, Hl, Wl) level so Hl is an 8-sublane and Wl a
+    128-lane multiple — the physical tiling Mosaic wants for the kernel's
+    VMEM blocks. Zero padding is semantically free for the lookup: a window
+    corner landing in the pad region one-hot-selects a zero, which IS the
+    reference's zeros-padding rule (corr.py bilinear_sampler zeros mode).
+
+    Callers running the lookup inside a scan (RAFT's 20-iteration GRU)
+    should align the loop-invariant pyramid ONCE before the scan — XLA does
+    not hoist the pads out of the while body, and paying them per iteration
+    measured ~30% of the whole RAFT forward."""
+    _, _, hl, wl = corr.shape
+    hlp = -(-hl // 8) * 8
+    wlp = -(-wl // 128) * 128
+    if (hlp, wlp) == (hl, wl):
+        return corr
+    return jnp.pad(corr, ((0, 0), (0, 0), (0, hlp - hl), (0, wlp - wl)))
+
+
+def _best_tile(p: int, cap: int) -> int:
+    """Largest divisor of p that is <= cap and usable as a block's
+    second-minor dim (multiple of 8, or the whole array, per the Pallas TPU
+    block rule); a dividing tile means no P padding of the coords and no
+    output slice — both of which would otherwise run EVERY scan iteration
+    (for RAFT's 224px geometry, P=784 with tile 128 re-padded to 896 and
+    re-sliced 20 times per forward). Falls back to an 8-aligned cap (pad
+    path) when p has no usable divisor >= 32."""
+    for t in range(min(cap, p), 0, -1):
+        if p % t == 0 and (t % 8 == 0 or t == p) and t >= 32:
+            return t
+    return max(8, (min(cap, p) // 8) * 8)
+
+
+#: VMEM budget for one corr block (leaves room for Mosaic's double
+#: buffering + the selector/accumulator tensors). Sizing the tile to fill
+#: this matters: with tiles capped at 128 queries the grid ran 448 programs
+#: per level and ALL levels cost the same ~25 ms/forward — pure
+#: per-program overhead, not compute or DMA.
+_VMEM_BLOCK_BYTES = 2 * 1024 * 1024  # corr-block bytes; hardware-probed on
+#                                      v5e: 4 MiB blocks compile standalone
+#                                      but overflow INSIDE the jitted RAFT
+#                                      scan (VMEM is shared with the
+#                                      surrounding program), 2 MiB fits
+_MAX_TILE_P = 256
 
 
 @functools.partial(jax.jit,
@@ -128,12 +179,22 @@ def _level_kernel(px0_ref, py0_ref, corr_ref, out_ref, *, radius: int):
 def corr_lookup_level_pallas(corr: jnp.ndarray, px0: jnp.ndarray,
                              py0: jnp.ndarray, radius: int = 4,
                              interpret: bool = False,
-                             tile_p: int = 128) -> jnp.ndarray:
+                             tile_p: Optional[int] = None) -> jnp.ndarray:
     """One pyramid level: corr (B, P, Hl, Wl), window base coords px0/py0
     (B, P) (level coords minus radius). Returns (B, P, (2r+1)^2)."""
+    corr = align_level(corr)  # no-op when the caller pre-aligned
     b, p, hl, wl = corr.shape
     n = 2 * radius + 1
-    tp = min(tile_p, p)
+    if tile_p is None:
+        # as many queries per program as the VMEM budget allows: fewer,
+        # bigger programs matter because the coarse levels are
+        # per-program-latency-bound, not compute-bound
+        # the budget is the hard bound (it is the hardware-probed VMEM
+        # envelope); the floor of 8 only keeps the tile a legal sublane
+        # multiple for very large level planes (wide inputs)
+        tile_p = min(_MAX_TILE_P,
+                     max(8, _VMEM_BLOCK_BYTES // (hl * wl * 4)))
+    tp = _best_tile(p, tile_p)
     pp = -(-p // tp) * tp
     if pp != p:
         corr = jnp.pad(corr, ((0, 0), (0, pp - p), (0, 0), (0, 0)))
@@ -150,28 +211,35 @@ def corr_lookup_level_pallas(corr: jnp.ndarray, px0: jnp.ndarray,
             pl.BlockSpec((1, tp, hl, wl), lambda bi, pi: (bi, pi, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, tp, n, n), lambda bi, pi: (bi, pi, 0, 0),
+        out_specs=pl.BlockSpec((1, tp, n * n), lambda bi, pi: (bi, pi, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, pp, n, n), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, pp, n * n), jnp.float32),
         interpret=interpret,
     )(px0.astype(jnp.float32)[..., None, None],
       py0.astype(jnp.float32)[..., None, None], corr)
-    return out[:, :p].reshape(b, p, n * n)
+    return out[:, :p]
 
 
 def corr_lookup_pallas(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
                        radius: int = 4,
                        interpret: bool = False) -> jnp.ndarray:
     """Full 4-level lookup via the fused per-level kernel; same signature
-    and channel layout as :func:`corr_lookup_onehot`."""
+    and channel layout as :func:`corr_lookup_onehot`.
+
+    The pair-batch dim folds into the query dim before the kernel: the
+    lookup is purely per-query, so (B, P) queries are just B*P queries —
+    one flat grid instead of a (B, P/tile) one. The coarse levels are
+    per-program-latency-bound (tiny DMAs), so halving the program count
+    measurably shortens the RAFT scan."""
     b, h, w, _ = coords.shape
     p = h * w
-    cx = coords[..., 0].reshape(b, p)
-    cy = coords[..., 1].reshape(b, p)
+    cx = coords[..., 0].reshape(1, b * p)
+    cy = coords[..., 1].reshape(1, b * p)
     out: List[jnp.ndarray] = []
     for lvl, corr in enumerate(pyramid):
         px0 = cx / (2 ** lvl) - radius
         py0 = cy / (2 ** lvl) - radius
-        out.append(corr_lookup_level_pallas(corr, px0, py0, radius,
+        flat = corr.reshape(1, b * p, *corr.shape[2:])
+        out.append(corr_lookup_level_pallas(flat, px0, py0, radius,
                                             interpret=interpret))
     return jnp.concatenate(out, axis=-1).reshape(b, h, w, -1)

@@ -205,6 +205,18 @@ def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     return pyramid
 
 
+def _corr_impl() -> str:
+    """Trace-time corr-lookup implementation choice (see corr_lookup)."""
+    import os
+    impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
+    if not impl:
+        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if impl not in ("gather", "onehot", "pallas"):
+        raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
+                         "'gather', 'onehot' or 'pallas'")
+    return impl
+
+
 def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
                 radius: int = CORR_RADIUS) -> jnp.ndarray:
     """Windowed bilinear lookup — implementation dispatcher.
@@ -232,10 +244,7 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
     extractors' precision=float32 matmul-precision pin. Under
     precision=bfloat16 the contraction legitimately drifts ~8e-3 (MXU
     bf16), which is that mode's contract."""
-    import os
-    impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
-    if not impl:
-        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+    impl = _corr_impl()
     if impl == "onehot":
         from ..kernels.corr_lookup import corr_lookup_onehot
         return corr_lookup_onehot(pyramid, coords, radius)
@@ -244,9 +253,6 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
         from ..kernels.corr_lookup import corr_lookup_pallas
         return corr_lookup_pallas(pyramid, coords, radius,
                                   interpret=interpret_mode())
-    if impl != "gather":
-        raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
-                         "'gather', 'onehot' or 'pallas'")
     return corr_lookup_gather(pyramid, coords, radius)
 
 
@@ -352,6 +358,15 @@ class RAFT(nn.Module):
         fmaps = fnet(jnp.concatenate([image1, image2], axis=0))
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
         pyramid = build_corr_pyramid(fmap1, fmap2)
+        if _corr_impl() == "pallas":
+            # tile-align the loop-invariant pyramid ONCE, outside the scan:
+            # the pallas lookup needs (8, 128)-aligned level planes, and XLA
+            # does not hoist the pads out of the while body — unhoisted they
+            # ran 20x per forward and cost ~30% of the whole RAFT step
+            # (kernels/corr_lookup.py align_level; zero pads are exactly the
+            # reference's out-of-range zeros rule)
+            from ..kernels.corr_lookup import align_level
+            pyramid = tuple(align_level(c) for c in pyramid)
 
         cnet = BasicEncoder(HIDDEN_DIM + CONTEXT_DIM, "batch",
                             name="cnet")(image1)

@@ -69,14 +69,19 @@ class FlowStream:
             # the reference hardcodes the sintel checkpoint for the i3d flow
             # sub-model (extract_i3d.py:178); flow_iters trades flow accuracy
             # for speed (fewer GRU refinement steps) — default is the
-            # reference's fixed 20 (raft.py:118)
+            # reference's fixed 20 (raft.py:118). Under precision=bfloat16
+            # the RAFT conv stacks run bf16 too (models/raft.py RAFT.dtype):
+            # the ~0.1 px flow drift is well under the ToUInt8 quantization
+            # step this stream applies anyway. The standalone RAFT extractor
+            # stays f32 — there the flow field IS the output.
             iters = int(args.get("flow_iters") or raft_model.ITERS)
-            flow_model = raft_model.RAFT(iters=iters)
+            flow_model = raft_model.RAFT(iters=iters, dtype=dtype)
             flow_params = store.resolve_params(
                 "raft_sintel", raft_model.init_params,
                 raft_model.params_from_torch,
                 weights_path=args.get("flow_model_weights_path"),
                 allow_random=allow_random)
+            flow_params = cast_floating(flow_params, dtype)
             self._quant_fn = partial(_raft_quantized_flow, flow_model, crop)
             self.pair_runner = DataParallelApply(
                 self._quant_fn, flow_params,

@@ -214,6 +214,11 @@ def corr_lookup_level_pallas(corr: jnp.ndarray, px0: jnp.ndarray,
         out_specs=pl.BlockSpec((1, tp, n * n), lambda bi, pi: (bi, pi, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, pp, n * n), jnp.float32),
+        # grid iterations are independent (each owns its query tile):
+        # declaring them parallel lets Mosaic pipeline the block DMAs more
+        # aggressively (the coarse levels are DMA-latency-bound)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(px0.astype(jnp.float32)[..., None, None],
       py0.astype(jnp.float32)[..., None, None], corr)

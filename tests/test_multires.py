@@ -1,0 +1,57 @@
+"""Mixed-geometry multi-video extraction in one CLI run.
+
+The reference ships TWO sample videos with different geometry and timing
+(v_GGSY1Qvo990: 355f @19.62fps 320x240; v_ZNVhz7ctTq0: 420f @30fps
+480x360) but its tests only ever exercise the first. One run over both
+pins the per-resolution behavior the single-video tests can't see:
+
+  - the work-list loop carries state across videos of different shapes;
+  - under ``resize=device`` the per-source-resolution runner cache
+    (extractors/base.py _cached_resize_runner) must compile one executable
+    per geometry and keep both live;
+  - fps resampling derives from each video's own fps (30 vs 19.62);
+  - outputs land under one dir with the {stem}_{key}.npy contract.
+
+Skips when the second sample is absent (it has no synthesized stand-in:
+the point is real mixed containers).
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_ROOT  # single mount-path definition
+
+SAMPLE2 = os.path.join(REFERENCE_ROOT, "sample", "v_ZNVhz7ctTq0.mp4")
+
+
+@pytest.mark.parametrize("resize", ["host", "device"])
+def test_two_videos_two_geometries_one_run(resize, sample_video, tmp_path):
+    if not os.path.exists(SAMPLE2):
+        pytest.skip("second reference sample not available")
+    out = tmp_path / "out"
+    cmd = [sys.executable, "main.py", "feature_type=resnet",
+           "model_name=resnet18", "device=cpu", "batch_size=16",
+           "extraction_fps=2", "allow_random_weights=true",
+           f"resize={resize}", "on_extraction=save_numpy",
+           f"output_path={out}", f"tmp_path={tmp_path / 'tmp'}",
+           f"video_paths=[{sample_video},{SAMPLE2}]"]
+    res = subprocess.run(cmd, cwd=str(Path(__file__).resolve().parent.parent),
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+
+    feat_dir = out / "resnet" / "resnet18"
+    # fps rule (golden-pinned): round(n_frames * 2 / src_fps)
+    expect = {Path(sample_video).stem: round(355 * 2 / 19.62),
+              "v_ZNVhz7ctTq0": round(420 * 2 / 30.0)}
+    for stem, n in expect.items():
+        feats = np.load(feat_dir / f"{stem}_resnet.npy")
+        ts = np.load(feat_dir / f"{stem}_timestamps_ms.npy")
+        fps = np.load(feat_dir / f"{stem}_fps.npy")
+        assert feats.shape == (n, 512), (stem, feats.shape)
+        assert ts.shape == (n,)
+        assert float(fps) == 2.0
+        assert np.isfinite(feats).all()

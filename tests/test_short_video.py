@@ -1,0 +1,60 @@
+"""Videos shorter than one stack window: the drop-partial-tail contract.
+
+The reference drops the trailing partial stack (``form_slices``,
+utils/utils.py:59-68) and its i3d loop only fires on full ``stack_size+1``
+accumulations — a video shorter than one window therefore produces EMPTY
+feature arrays, a warning from the sink, and no crash (the per-video error
+isolation never even engages). Pinned here for the clip-stack and i3d
+pipelines, which do their own windowing.
+"""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def six_frame_video(tmp_path_factory):
+    import cv2
+    path = str(tmp_path_factory.mktemp("short") / "v_short6.mp4")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 10.0,
+                        (64, 64))
+    if not w.isOpened():
+        pytest.skip("cv2 cannot encode mp4v")
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, size=(64, 64, 3), dtype=np.uint8)
+    for t in range(6):
+        w.write(np.roll(base, t, axis=1))
+    w.release()
+    return path
+
+
+def _cfg(ft, video, tmp_path, **patch):
+    from video_features_tpu.config import load_config, sanity_check
+    cfg = load_config(ft, dict({
+        "video_paths": video, "device": "cpu",
+        "allow_random_weights": True, "on_extraction": "save_numpy",
+        "output_path": str(tmp_path / "out"),
+        "tmp_path": str(tmp_path / "tmp")}, **patch))
+    sanity_check(cfg)
+    return cfg
+
+
+def test_r21d_shorter_than_stack_yields_empty(six_frame_video, tmp_path,
+                                              capsys):
+    from video_features_tpu.registry import get_extractor_cls
+    # default r2plus1d_18_16 stack=16 > 6 frames -> zero windows
+    ex = get_extractor_cls("r21d")(_cfg("r21d", six_frame_video, tmp_path))
+    feats = ex._extract(six_frame_video)
+    assert feats["r21d"].shape[0] == 0
+    out = capsys.readouterr().out
+    assert "empty" in out.lower()  # the sink's empty-value warning fired
+
+
+def test_i3d_shorter_than_stack_yields_empty(six_frame_video, tmp_path):
+    from video_features_tpu.registry import get_extractor_cls
+    ex = get_extractor_cls("i3d")(_cfg(
+        "i3d", six_frame_video, tmp_path,
+        stack_size=10, step_size=10, streams="rgb"))
+    feats = ex.extract(six_frame_video)
+    assert feats["rgb"].shape[0] == 0
+    assert feats["timestamps_ms"].shape == (0,)
+    assert float(feats["fps"]) == 10.0

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Summarize a ``jax.profiler`` trace: per-op device-time table.
+
+Companion to the `profile_trace_dir=` CLI knob (utils/profiling.py
+TraceCapture): point it at the capture directory and get the top device ops
+without TensorBoard — this is the exact analysis that located both round-2
+performance wins (the r21d per-layer breakdown and the RAFT scan's
+per-iteration relayout passes).
+
+Usage:
+    python main.py feature_type=... profile_trace_dir=/tmp/trace ...
+    python scripts/profile_trace.py /tmp/trace [--top 25] [--iters N]
+
+``--iters N`` divides durations by N (pass the number of timed steps the
+capture covered to read per-step costs directly).
+
+Mapping fusion names back to HLO: dump the compiled program via
+``jitted.lower(*args).compile().as_text()`` and search for the fusion name —
+each carries ``metadata={op_name=... source_file=...}`` pointing at the
+Python that emitted it.
+
+Caveat (tunneled dev chips): events here are DEVICE timeline spans, so they
+are trustworthy even where wall-clock microbenchmarks are not; but nested
+spans (e.g. a while loop and the fusions inside it) each carry their full
+duration, so the table over-counts hierarchies — read it top-down.
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_trace(trace_dir: str) -> dict:
+    pats = [os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            os.path.join(trace_dir, "**", "*.trace.json")]
+    hits = sorted(h for p in pats for h in glob.glob(p, recursive=True))
+    if not hits:
+        raise SystemExit(f"no *.trace.json[.gz] under {trace_dir} — was it "
+                         "captured with jax.profiler.trace / "
+                         "profile_trace_dir=?")
+    # newest capture run wins (run dirs are timestamps); a multi-process
+    # capture writes one trace per host into that run — summarize ONE host
+    # and say so rather than silently merging or dropping
+    run_dir = os.path.dirname(hits[-1])
+    run_hits = [h for h in hits if os.path.dirname(h) == run_dir]
+    path = run_hits[-1]
+    if len(run_hits) > 1:
+        print(f"NOTE: {len(run_hits)} host traces in this capture; "
+              f"summarizing {os.path.basename(path)} only", file=sys.stderr)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def device_op_table(trace: dict):
+    """[(name, total_us)] for complete events on device-side process rows."""
+    events = trace.get("traceEvents", [])
+    proc_names = {e["pid"]: e.get("args", {}).get("name", "")
+                  for e in events
+                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+    per_op = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and "dur" in e:
+            pname = proc_names.get(e.get("pid"), "")
+            if "TPU" in pname or "GPU" in pname:
+                per_op[e["name"]] += e["dur"]
+    return per_op.most_common()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="per-op device-time summary of a jax.profiler trace")
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--iters", type=int, default=1,
+                    help="timed steps in the capture: durations are "
+                         "divided by this")
+    args = ap.parse_args()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+
+    table = device_op_table(load_trace(args.trace_dir))
+    if not table:
+        raise SystemExit("no device-side complete events found (CPU-only "
+                         "trace? the device timeline needs a TPU/GPU run)")
+    total = sum(us for _, us in table)
+    print(f"{'ms/iter':>10}  {'share':>6}  op")
+    for name, us in table[:args.top]:
+        print(f"{us / args.iters / 1e3:10.2f}  {us / total * 100:5.1f}%  "
+              f"{name[:100]}")
+    print(f"\ntotal device time: {total / args.iters / 1e3:.1f} ms/iter "
+          f"(nested spans over-count; read top-down)")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,3 +68,58 @@ def test_corr_lookup_pallas_matches_gather(rng):
     ours = np.asarray(corr_lookup_pallas(pyramid, coords, interpret=True))
     assert ours.shape == ref.shape
     np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_corr_lookup_packed_matches_gather(rng):
+    """The lane-dense packed fused kernel (VFT_CORR_LOOKUP=packed, the
+    measured negative-result alternative) keeps exact lookup semantics."""
+    from video_features_tpu.kernels.corr_lookup import (corr_lookup_packed,
+                                                        pack_pyramid)
+    pyramid, coords, _ = _pyramid_and_coords(rng)
+    packed, metas = pack_pyramid(pyramid)
+    ref = np.asarray(corr_lookup_gather(pyramid, coords))
+    ours = np.asarray(corr_lookup_packed(packed, metas, coords,
+                                         interpret=True))
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_corr_lookup_packed_degenerate_pyramid(rng):
+    """Tiny inputs pool down to 1x1 and then 0x0 levels; the packed kernel
+    must reproduce the gather's all-zeros semantics for both (the fused
+    kernel stores an explicit zero placeholder plane, corr_lookup.py
+    _plan_level)."""
+    from video_features_tpu.kernels.corr_lookup import (corr_lookup_packed,
+                                                        pack_pyramid)
+    pyramid, coords, _ = _pyramid_and_coords(rng, h8=6, w8=5, c=16)
+    shapes = [tuple(c.shape[2:]) for c in pyramid]
+    assert (1, 1) in shapes and (0, 0) in shapes, shapes
+    packed, metas = pack_pyramid(pyramid)
+    ref = np.asarray(corr_lookup_gather(pyramid, coords))
+    ours = np.asarray(corr_lookup_packed(packed, metas, coords,
+                                         interpret=True))
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_pack_pyramid_geometry(rng):
+    """The lane-dense packing stays dense: one 128-lane line carries
+    multiple narrow image rows, all levels' row-groups share ONE fused
+    lane plane, and zero fill covers phantom rows + lane tails (the
+    zeros-padding rule)."""
+    from video_features_tpu.kernels.corr_lookup import pack_pyramid
+    pyramid, _, _ = _pyramid_and_coords(rng, b=2, h8=28, w8=28, c=16)
+    packed, metas = pack_pyramid(pyramid)
+    # RAFT-224 finest level: 4 rows of 28 cols per 128-lane line, 7 groups
+    m0 = metas[0]
+    assert (m0.j, m0.g, m0.k, m0.off) == (4, 7, 128, 0)
+    b, p = pyramid[0].shape[:2]
+    assert packed.shape == (b * p, sum(m.g * m.k for m in metas))
+    assert metas[1].off == 7 * 128
+    # spot value: query (b=1, p=5), image row 9 col 3 -> group 2, sub-row 1
+    want = float(pyramid[0][1, 5, 9, 3])
+    got = float(packed[p + 5, 2 * 128 + 1 * 28 + 3])
+    assert got == want
+    # level-0 lane tail beyond j*wl is zero fill in every group
+    for g in range(7):
+        tail = packed[:, g * 128 + 112:(g + 1) * 128]
+        assert float(jnp.abs(tail).max()) == 0.0

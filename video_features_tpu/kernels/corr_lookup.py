@@ -20,17 +20,40 @@ reduce the (10, 10) corner window to the (9, 9) tap window with scalar
 weights per query. Channel order matches the reference quirk (x-offset
 slowest; corr.py:37-43 adds its meshgrid "dy" to x).
 
-Two implementations with identical numerics:
+Implementations with identical numerics:
 
   - :func:`corr_lookup_onehot` — pure jnp/XLA (runs anywhere);
-  - :func:`corr_lookup_level_pallas` — fused Pallas kernel per level: the
-    one-hots are built in VMEM and contracted in-kernel, so the (P, 10, Hl)
-    selector tensors never touch HBM.
+  - :func:`corr_lookup_level_pallas` / :func:`corr_lookup_pallas` — fused
+    Pallas kernel per level over lane-PADDED planes: the one-hots are built
+    in VMEM and contracted in-kernel, so the (P, 10, Hl) selector tensors
+    never touch HBM. **TPU default** (fastest measured).
+  - :func:`corr_lookup_packed` — ONE fused kernel for ALL levels over a
+    lane-DENSE repacked pyramid (``VFT_CORR_LOOKUP=packed``). Kept as a
+    measured negative result — see below.
+
+Round-3 negative result (recorded so nobody re-litigates it from theory):
+the per-level default lane-pads narrow planes (28 -> 128 at RAFT-224's
+finest level), so round 2 hypothesized a ~4.6x useless-DMA tax as the
+throughput floor. Round 3 built the lane-dense alternative — J=4 image
+rows per 128-lane line, all levels' row-groups fused into one (Q, 1408)
+plane, 5.8x fewer bytes per GRU iteration (282 MB vs 1.64 GB), one kernel
+launch instead of four — and measured the flagship I3D RGB+Flow bench on
+v5e across six structural variants (fused 1-call Pallas, per-level 4-call
+Pallas, pure-XLA einsum form, tile sweeps 32..512, empty-body DMA floor,
+select-vs-dot row routing): EVERY dense variant landed at 3.47-3.60
+stacks/s vs 3.95 for the padded default, same-day A/B. An empty kernel
+body over the same blocks cost the same as the full kernel. Conclusion:
+the lookup is bound by per-query selection work (mask/select VPU ops +
+grid machinery), NOT by HBM bytes — the padded layout wins because its
+selectors are plain 2-compare iota one-hots, while any dense packing must
+additionally route J-packed rows (G-way selects or an extra mask pass),
+which costs more than the bytes it saves.
 """
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+import os
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +80,7 @@ def _blend(window: jnp.ndarray, fx: jnp.ndarray, fy: jnp.ndarray,
 
 def corr_lookup_onehot(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
                        radius: int = 4) -> jnp.ndarray:
-    """Pure-XLA twin of the fused kernel. pyramid: per level (B, P, Hl, Wl);
+    """Pure-XLA twin of the fused kernels. pyramid: per level (B, P, Hl, Wl);
     coords: (B, H, W, 2) level-0 (x, y). Returns (B, H, W, L*(2r+1)^2)."""
     b, h, w, _ = coords.shape
     p = h * w
@@ -83,6 +106,8 @@ def corr_lookup_onehot(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
         out.append(_blend(window, px0 - ix, py0 - iy, n))
     return jnp.concatenate(out, axis=-1).reshape(b, h, w, -1)
 
+
+# ---- per-level fused kernel over lane-padded planes (TPU default) --------
 
 def _level_kernel(px0_ref, py0_ref, corr_ref, out_ref, *, radius: int):
     """Block shapes: px0/py0 (1, TP, 1, 1) — pre-expanded on the host so no
@@ -174,6 +199,21 @@ _VMEM_BLOCK_BYTES = 2 * 1024 * 1024  # corr-block bytes; hardware-probed on
 _MAX_TILE_P = 256
 
 
+def pallas_lookup_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
+    """Whether the per-level kernel can tile these planes within the probed
+    VMEM envelope: even an 8-query tile must fit the budget. False only for
+    extreme inputs (~>5800 px on a side at RAFT's /8 feature stride) where
+    ``_VMEM_BLOCK_BYTES // plane_bytes`` underflows and the 8-query floor
+    would demand a >16 MiB block. Callers fall back to
+    :func:`corr_lookup_onehot`, the tiling-free twin."""
+    for c in pyramid:
+        hl, wl = c.shape[2], c.shape[3]
+        plane = (-(-hl // 8) * 8) * (-(-wl // 128) * 128) * 4
+        if 8 * plane > _VMEM_BLOCK_BYTES:
+            return False
+    return True
+
+
 @functools.partial(jax.jit,
                    static_argnames=("radius", "interpret", "tile_p"))
 def corr_lookup_level_pallas(corr: jnp.ndarray, px0: jnp.ndarray,
@@ -188,10 +228,15 @@ def corr_lookup_level_pallas(corr: jnp.ndarray, px0: jnp.ndarray,
     if tile_p is None:
         # as many queries per program as the VMEM budget allows: fewer,
         # bigger programs matter because the coarse levels are
-        # per-program-latency-bound, not compute-bound
-        # the budget is the hard bound (it is the hardware-probed VMEM
-        # envelope); the floor of 8 only keeps the tile a legal sublane
-        # multiple for very large level planes (wide inputs)
+        # per-program-latency-bound, not compute-bound. The floor of 8
+        # keeps the tile a legal sublane multiple; oversized planes where
+        # even that floor would bust the budget are refused loudly
+        # (pallas_lookup_supported is the caller-facing check).
+        if 8 * hl * wl * 4 > _VMEM_BLOCK_BYTES:
+            raise ValueError(
+                f"corr plane ({hl}x{wl}) too large for any legal VMEM "
+                "tile; use corr_lookup_onehot (pallas_lookup_supported "
+                "gates this dispatch)")
         tile_p = min(_MAX_TILE_P,
                      max(8, _VMEM_BLOCK_BYTES // (hl * wl * 4)))
     tp = _best_tile(p, tile_p)
@@ -248,3 +293,249 @@ def corr_lookup_pallas(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
         out.append(corr_lookup_level_pallas(flat, px0, py0, radius,
                                             interpret=interpret))
     return jnp.concatenate(out, axis=-1).reshape(b, h, w, -1)
+
+
+# ---- lane-dense packed pyramid (opt-in: VFT_CORR_LOOKUP=packed) ----------
+#
+# Measured ~10% SLOWER end-to-end than the per-level default on v5e (see
+# the module docstring's negative-result record) — retained because the
+# layout is the textbook fix for the padding tax and the measurement that
+# refutes it should stay reproducible.
+
+class LevelMeta(NamedTuple):
+    """Static packing geometry of one pyramid level."""
+    hl: int   # image rows
+    wl: int   # image cols
+    j: int    # rows packed per 128-lane line
+    g: int    # row-groups (ceil(hl / j))
+    k: int    # packed lane width (j*wl rounded up to 128)
+    off: int = 0  # lane offset of this level in the fused (Q, K_total) plane
+
+
+def _plan_level(hl: int, wl: int) -> LevelMeta:
+    if hl == 0 or wl == 0:
+        # degenerate level (tiny inputs pool to nothing): every tap reads
+        # the zeros-padding region, so a placeholder one-lane-line plane of
+        # zeros reproduces the gather semantics exactly
+        return LevelMeta(0, 0, 1, 1, 128)
+    j = min(hl, max(1, 128 // wl))
+    g = -(-hl // j)
+    k = -(-(j * wl) // 128) * 128
+    return LevelMeta(hl, wl, j, g, k)
+
+
+def pack_level(corr: jnp.ndarray) -> Tuple[jnp.ndarray, LevelMeta]:
+    """(B, P, Hl, Wl) level -> ((B*P, G*K) lane-dense row-group planes,
+    meta). Row-group g of query q lives in lanes [g*K, g*K + K).
+
+    Zero fill everywhere the packed layout exceeds the image plane (phantom
+    rows of the last group, lane tail beyond J*Wl): a window corner landing
+    there selects a zero, which IS the reference's zeros-padding rule
+    (corr.py bilinear_sampler zeros mode)."""
+    b, p, hl, wl = corr.shape
+    m = _plan_level(hl, wl)
+    if m.hl == 0:
+        return jnp.zeros((b * p, m.g * m.k), corr.dtype), m
+    x = corr.reshape(b * p, hl, wl)
+    if m.g * m.j != hl:
+        x = jnp.pad(x, ((0, 0), (0, m.g * m.j - hl), (0, 0)))
+    x = x.reshape(b * p, m.g, m.j * wl)
+    if m.k != m.j * wl:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, m.k - m.j * wl)))
+    return x.reshape(b * p, m.g * m.k), m
+
+
+def fused_lookup_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
+    """Whether the packed fused kernel can tile these levels within the
+    probed VMEM envelope (one query's packed planes must fit
+    _VMEM_BLOCK_BYTES) with a sane unroll (the G-way select-accumulate is
+    statically unrolled; G grows with input size — 28 groups at 448 px —
+    and past ~16 the routing chain is hopeless anyway, see the module
+    docstring's negative result). Callers fall back to
+    corr_lookup_onehot."""
+    metas = [_plan_level(c.shape[2], c.shape[3]) for c in pyramid]
+    per_q = sum(m.g * m.k for m in metas) * 4
+    return per_q <= _VMEM_BLOCK_BYTES and max(m.g for m in metas) <= 16
+
+
+def pack_pyramid(pyramid: Sequence[jnp.ndarray]
+                 ) -> Tuple[jnp.ndarray, Tuple[LevelMeta, ...]]:
+    """All levels -> ONE (B*P, K_total) lane-dense plane + per-level metas
+    carrying each level's lane offset (one contiguous block DMA per grid
+    step). Hoist this OUT of the GRU scan — XLA does not hoist relayouts
+    out of while bodies."""
+    packed, metas = zip(*(pack_level(c) for c in pyramid))
+    offs = []
+    off = 0
+    for m in metas:
+        offs.append(m._replace(off=off))
+        off += m.g * m.k
+    return jnp.concatenate(packed, axis=1), tuple(offs)
+
+
+def _packed_kernel(cx_ref, cy_ref, corr_ref, out_ref, *, radius: int,
+                   metas: Tuple[LevelMeta, ...]):
+    """One grid step: TILE_Q queries x ALL pyramid levels.
+
+    Block shapes: cx/cy (TQ, 1, 1); corr (TQ, K_total) — ONE contiguous
+    lane-dense plane carrying every level's row-groups (level l group g at
+    lanes [off_l + g*K_l, ...), selected in-kernel by static lane slices,
+    free at the 128-lane tile granularity); out (TQ, L*n*n) with per-level
+    tap channel k = xx*n + yy (x-offset slowest — the reference's order),
+    levels concatenated in pyramid order."""
+    n = 2 * radius + 1
+    cx = cx_ref[...]  # (TQ, 1, 1)
+    cy = cy_ref[...]
+    corr_all = corr_ref[...].astype(jnp.float32)  # (TQ, K_total)
+    tq = corr_all.shape[0]
+    d10 = jax.lax.broadcasted_iota(
+        jnp.int32, (1, n + 1, 1), 1).astype(jnp.float32)
+    for lvl, m in enumerate(metas):
+        if m.hl == 0:  # degenerate level: all taps hit the zeros padding
+            zeros = jnp.zeros((tq, n), jnp.float32)
+            for i in range(n):
+                out_ref[:, (lvl * n + i) * n:(lvl * n + i + 1) * n] = zeros
+            continue
+        px0 = cx * (1.0 / (1 << lvl)) - radius
+        py0 = cy * (1.0 / (1 << lvl)) - radius
+        ix = jnp.floor(px0)
+        iy = jnp.floor(py0)
+        r = iy + d10   # (TQ, 10, 1) window-corner row indices
+        # lane coordinate -> (sub-row j, column w); Mosaic iota is
+        # integer-only, so the decomposition runs in f32 (exact: all values
+        # are small integers, and IEEE division of exact quotients is exact)
+        kf = jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, m.k), 2).astype(jnp.float32)
+        j_of_k = jnp.floor(kf / m.wl)
+        w_of_k = kf - m.wl * j_of_k
+
+        def plane(g):
+            # static lane slice (free at 128-lane tile granularity), then
+            # a rank-expand so the group plane broadcasts over the 10 rows.
+            # Explicit lax ops: jnp's mixed None/slice indexing can lower
+            # through gather, which Mosaic rejects.
+            sl = jax.lax.slice_in_dim(corr_all, m.off + g * m.k,
+                                      m.off + (g + 1) * m.k, axis=1)
+            return jax.lax.expand_dims(sl, (1,))  # (TQ, 1, K)
+
+        if m.g == 1:
+            # whole plane in one lane line set: row index IS the sub-row.
+            # No modulo here — a negative r must match nothing, not wrap.
+            jr = r
+            u = plane(0)  # broadcasts over the 10 rows
+        else:
+            g_of_r = jnp.floor(r / m.j)
+            jr = r - m.j * g_of_r
+            # G-way select-accumulate picks each corner row's group plane
+            # (G <= 8; out-of-range groups match nothing -> zero row, the
+            # zeros-padding rule again). This routing is the measured cost
+            # that eats the DMA savings — see the module docstring.
+            u = jnp.zeros((tq, n + 1, m.k), jnp.float32)
+            for g in range(m.g):
+                u = u + jnp.where(g_of_r == g, plane(g), 0.0)
+        v = jnp.where(j_of_k == jr, u, 0.0)          # (TQ, 10, K)
+        xb = (w_of_k == ix + d10).astype(jnp.float32)  # (TQ, 10, K)
+        window = jax.lax.dot_general(                 # (TQ, 10x, 10y)
+            xb, v, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        fx = px0 - ix  # (TQ, 1, 1), broadcasts over the window dims
+        fy = py0 - iy
+        blended = ((1 - fx) * (1 - fy) * window[:, :n, :n]
+                   + fx * (1 - fy) * window[:, 1:, :n]
+                   + (1 - fx) * fy * window[:, :n, 1:]
+                   + fx * fy * window[:, 1:, 1:])  # (TQ, n_x, n_y)
+        base = lvl * n * n
+        for i in range(n):  # static lane-sliced stores (Mosaic rejects
+            # 9-wide lane concats but accepts sliced stores)
+            out_ref[:, base + i * n:base + (i + 1) * n] = blended[:, i, :]
+
+
+#: Scoped-VMEM target for one packed grid step. v5e's scoped limit is
+#: 16 MiB (hardware-observed OOM reports say so exactly); 12 MiB leaves
+#: margin for the surrounding program, which matters INSIDE the RAFT GRU
+#: scan — the same kernel allocates more scoped VMEM in a while body than
+#: standalone (measured 20.16 MiB in-scan at TQ=256 vs compiling clean
+#: standalone).
+_VMEM_TARGET = 12 * 1024 * 1024
+_MAX_TILE_Q = 512
+
+
+@functools.partial(jax.jit, static_argnames=("metas", "radius", "interpret",
+                                             "tile_q", "out_dtype"))
+def _corr_lookup_packed_flat(packed: jnp.ndarray,
+                             metas: Tuple[LevelMeta, ...],
+                             cx: jnp.ndarray, cy: jnp.ndarray,
+                             radius: int = 4, interpret: bool = False,
+                             tile_q: Optional[int] = None,
+                             out_dtype=jnp.float32) -> jnp.ndarray:
+    """Flat-query fused lookup: packed (Q, K_total) fused plane; cx/cy (Q,)
+    level-0 centers. Returns (Q, L*(2r+1)^2)."""
+    q = cx.shape[0]
+    n = 2 * radius + 1
+    per_q = sum(m.g * m.k for m in metas) * 4
+    if tile_q is None:
+        env = os.environ.get("VFT_CORR_TILE_Q", "").strip()
+        if env:  # perf-probe override (trace-time, like VFT_CORR_LOOKUP)
+            tile_q = int(env)
+    if tile_q is None:
+        # scoped-VMEM model per query, calibrated against measured Mosaic
+        # OOM reports (in-scan, the worst case): double-buffered corr blocks
+        # (2x per_q) plus ~(7 + G_max) live (TQ, n+1, K) f32 selector/
+        # accumulator tensors at the widest level — the G-way routing keeps
+        # its operands live, so the model scales with the unroll (in-scan
+        # OOM arithmetic: 20.16 MiB at TQ=256 for the RAFT-224 pyramid
+        # with G_max=7 = 78.8 KiB/query)
+        k_max = max(m.k for m in metas)
+        g_max = max(m.g for m in metas)
+        inter = (7 + g_max) * (n + 1) * 4 * k_max
+        tile_q = min(_MAX_TILE_Q,
+                     max(8, _VMEM_TARGET // (2 * per_q + inter)))
+    if per_q > _VMEM_BLOCK_BYTES:
+        # a single query's packed planes exceed the probed VMEM envelope
+        # (inputs ~>5800 px on a side): no legal tile exists, so refuse
+        # loudly rather than fault in Mosaic — callers can use the XLA
+        # one-hot twin at such sizes
+        raise ValueError(
+            f"corr planes too large for the fused kernel ({per_q} B/query "
+            f"> {_VMEM_BLOCK_BYTES} B VMEM budget); use corr_lookup_onehot")
+    tq = _best_tile(q, tile_q)
+    qq = -(-q // tq) * tq
+    if qq != q:
+        packed = jnp.pad(packed, ((0, qq - q), (0, 0)))
+        cx = jnp.pad(cx, (0, qq - q))
+        cy = jnp.pad(cy, (0, qq - q))
+    k_total = packed.shape[1]
+    coord_spec = pl.BlockSpec((tq, 1, 1), lambda qi: (qi, 0, 0),
+                              memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_packed_kernel, radius=radius, metas=metas),
+        grid=(qq // tq,),
+        in_specs=[coord_spec, coord_spec,
+                  pl.BlockSpec((tq, k_total), lambda qi: (qi, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((tq, len(metas) * n * n),
+                               lambda qi: (qi, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((qq, len(metas) * n * n), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(cx[:, None, None].astype(jnp.float32),
+      cy[:, None, None].astype(jnp.float32), packed)
+    return out[:q]
+
+
+def corr_lookup_packed(packed: jnp.ndarray,
+                       metas: Tuple[LevelMeta, ...], coords: jnp.ndarray,
+                       radius: int = 4, interpret: bool = False,
+                       tile_q: Optional[int] = None) -> jnp.ndarray:
+    """Fused lookup over a pre-packed pyramid (see :func:`pack_pyramid`).
+
+    coords: (B, H, W, 2) level-0 (x, y) with B folded into Q = B*H*W at
+    pack time (the lookup is purely per-query). Returns
+    (B, H, W, L*(2r+1)^2) in the reference's level/tap channel order."""
+    b, h, w, _ = coords.shape
+    cx = coords[..., 0].reshape(b * h * w)
+    cy = coords[..., 1].reshape(b * h * w)
+    out = _corr_lookup_packed_flat(packed, metas, cx, cy, radius,
+                                   interpret, tile_q)
+    return out.reshape(b, h, w, -1)

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Dict, List, Mapping, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -159,7 +159,12 @@ class FlowHead(nn.Module):
 class UpdateIter(nn.Module):
     """One RAFT iteration: corr lookup + BasicUpdateBlock (update.py:123-144;
     the mask head is applied separately, see RAFT.__call__). Shaped as a
-    ``lax.scan`` body: (carry, broadcast-inputs) -> (carry, None)."""
+    ``lax.scan`` body: (carry, broadcast-inputs) -> (carry, None).
+
+    ``corr_meta`` (static) marks the broadcast ``pyramid`` input as
+    lane-dense-packed for the fused Pallas lookup (kernels/corr_lookup.py
+    pack_pyramid); ``None`` means raw (B, P, Hl, Wl) levels."""
+    corr_meta: Optional[Tuple[Any, ...]] = None
 
     @nn.compact
     def __call__(self, carry, inputs):
@@ -169,7 +174,8 @@ class UpdateIter(nn.Module):
         # mode its (B,H,W,324) output and the flow join the hidden state's
         # dtype so the update convs stay on the MXU-native dtype. coords
         # stay f32 through the carry: delta promotes back on add.
-        corr = corr_lookup(pyramid, coords1).astype(net.dtype)
+        corr = corr_lookup(pyramid, coords1,
+                           packed_meta=self.corr_meta).astype(net.dtype)
         flow = (coords1 - coords0).astype(net.dtype)
         motion = BasicMotionEncoder(name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
@@ -216,28 +222,46 @@ def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     return pyramid
 
 
+def _fused_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
+    from ..kernels.corr_lookup import fused_lookup_supported
+    return fused_lookup_supported(pyramid)
+
+
+def _pallas_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
+    from ..kernels.corr_lookup import pallas_lookup_supported
+    return pallas_lookup_supported(pyramid)
+
+
 def _corr_impl() -> str:
     """Trace-time corr-lookup implementation choice (see corr_lookup)."""
     import os
     impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
     if not impl:
         impl = "pallas" if jax.default_backend() == "tpu" else "gather"
-    if impl not in ("gather", "onehot", "pallas"):
+    if impl not in ("gather", "onehot", "pallas", "packed"):
         raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
-                         "'gather', 'onehot' or 'pallas'")
+                         "'gather', 'onehot', 'pallas' or 'packed'")
     return impl
 
 
 def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
-                radius: int = CORR_RADIUS) -> jnp.ndarray:
+                radius: int = CORR_RADIUS,
+                packed_meta: Optional[Tuple[Any, ...]] = None) -> jnp.ndarray:
     """Windowed bilinear lookup — implementation dispatcher.
 
-    ``VFT_CORR_LOOKUP`` selects ``gather``, ``onehot`` or ``pallas``
-    (kernels/corr_lookup.py); unset picks ``pallas`` on TPU and ``gather``
-    elsewhere. The env var is read at TRACE time: it must be set before the
-    first RAFT forward of the process — once the jitted scan body is
-    compiled, changing it has no effect (same caveat as every static jit
-    switch).
+    ``packed_meta`` not None means ``pyramid`` holds lane-dense-packed
+    levels (kernels/corr_lookup.py pack_pyramid) and routes straight to the
+    fused Pallas kernel — the RAFT scan path, where the pack is hoisted out
+    of the 20-iteration GRU loop.
+
+    ``VFT_CORR_LOOKUP`` selects ``gather``, ``onehot``, ``pallas`` or
+    ``packed`` (kernels/corr_lookup.py; ``packed`` is the lane-dense
+    fused-kernel alternative kept as a measured negative result — ~10%
+    slower end-to-end than ``pallas`` on v5e despite 5.8x fewer DMA
+    bytes). Unset picks ``pallas`` on TPU and ``gather`` elsewhere. The
+    env var is read at TRACE time: it must be set before the first RAFT
+    forward of the process — once the jitted scan body is compiled,
+    changing it has no effect (same caveat as every static jit switch).
 
     Measured END-TO-END on TPU v5e with a D2H-fenced timer
     (parallel/mesh.py settle — block_until_ready acks early through dev
@@ -256,11 +280,30 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
     precision=bfloat16 the contraction legitimately drifts ~8e-3 (MXU
     bf16), which is that mode's contract."""
     impl = _corr_impl()
+    if packed_meta is not None:
+        from ..kernels import interpret_mode
+        from ..kernels.corr_lookup import corr_lookup_packed
+        return corr_lookup_packed(pyramid, packed_meta, coords, radius,
+                                  interpret=interpret_mode())
     if impl == "onehot":
         from ..kernels.corr_lookup import corr_lookup_onehot
         return corr_lookup_onehot(pyramid, coords, radius)
-    if impl == "pallas":
+    if impl in ("pallas", "packed"):
+        supported = (_pallas_supported(pyramid) if impl == "pallas"
+                     else _fused_supported(pyramid))
+        if not supported:
+            # planes too large for any legal VMEM tile (inputs ~>5800 px on
+            # a side): the XLA one-hot twin has identical numerics and no
+            # tiling constraint
+            from ..kernels.corr_lookup import corr_lookup_onehot
+            return corr_lookup_onehot(pyramid, coords, radius)
         from ..kernels import interpret_mode
+        if impl == "packed":
+            from ..kernels.corr_lookup import pack_pyramid
+            packed, metas = pack_pyramid(pyramid)
+            from ..kernels.corr_lookup import corr_lookup_packed
+            return corr_lookup_packed(packed, metas, coords, radius,
+                                      interpret=interpret_mode())
         from ..kernels.corr_lookup import corr_lookup_pallas
         return corr_lookup_pallas(pyramid, coords, radius,
                                   interpret=interpret_mode())
@@ -379,7 +422,9 @@ class RAFT(nn.Module):
         fmaps = fnet(jnp.concatenate([image1, image2], axis=0))
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
         pyramid = build_corr_pyramid(fmap1, fmap2)
-        if _corr_impl() == "pallas":
+        corr_meta = None
+        impl = _corr_impl()
+        if impl == "pallas" and _pallas_supported(pyramid):
             # tile-align the loop-invariant pyramid ONCE, outside the scan:
             # the pallas lookup needs (8, 128)-aligned level planes, and XLA
             # does not hoist the pads out of the while body — unhoisted they
@@ -388,10 +433,16 @@ class RAFT(nn.Module):
             # reference's out-of-range zeros rule)
             from ..kernels.corr_lookup import align_level
             pyramid = tuple(align_level(c) for c in pyramid)
-            # (measured, not kept: a bf16 pyramid halves the lookup DMA
-            # bytes but the in-kernel bf16->f32 block conversion costs more
-            # than the bandwidth saves — 0.87x on v5e — so the pyramid stays
-            # f32 in every mode, which also keeps lookup precision exact)
+            # (measured, not kept as default: a lane-DENSE packed pyramid
+            # moves 5.8x fewer bytes but lands ~10% slower end-to-end —
+            # the lookup is selection-bound, not DMA-bound. The packed
+            # kernel stays available as VFT_CORR_LOOKUP=packed; the
+            # negative-result record lives in kernels/corr_lookup.py.)
+        elif impl == "packed" and _fused_supported(pyramid):
+            # lane-dense-pack ONCE outside the scan; ONE fused kernel
+            # serves all four levels per iteration
+            from ..kernels.corr_lookup import pack_pyramid
+            pyramid, corr_meta = pack_pyramid(pyramid)
 
         cnet = BasicEncoder(HIDDEN_DIM + CONTEXT_DIM, "batch",
                             name="cnet")(image1)
@@ -409,7 +460,7 @@ class RAFT(nn.Module):
         scanned = nn.scan(
             UpdateIter, variable_broadcast="params",
             split_rngs={"params": False}, in_axes=nn.broadcast,
-            length=self.iters)(name="update_block")
+            length=self.iters)(corr_meta=corr_meta, name="update_block")
         (net, coords1), _ = scanned((net, coords0), (pyramid, inp, coords0))
 
         mask = MaskHead(name="update_mask")(net)

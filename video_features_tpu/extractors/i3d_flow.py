@@ -74,7 +74,12 @@ class FlowStream:
             # the ~0.1 px flow drift is well under the ToUInt8 quantization
             # step this stream applies anyway. The standalone RAFT extractor
             # stays f32 — there the flow field IS the output.
-            iters = int(args.get("flow_iters") or raft_model.ITERS)
+            raw = args.get("flow_iters")
+            iters = raft_model.ITERS if raw is None else int(raw)
+            if iters < 1:
+                raise ValueError(
+                    f"flow_iters={iters}: RAFT needs at least one GRU "
+                    "refinement iteration")
             flow_model = raft_model.RAFT(iters=iters, dtype=dtype)
             flow_params = store.resolve_params(
                 "raft_sintel", raft_model.init_params,

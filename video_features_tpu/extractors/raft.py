@@ -37,8 +37,13 @@ class ExtractRAFT(OpticalFlowExtractor):
                 "sintel/kitti (extract_raft.py:6-9)")
         # iters trades flow accuracy for speed (fewer GRU refinement steps);
         # default is the reference's fixed 20 (raft.py:118)
-        self.model = raft_model.RAFT(
-            iters=int(args.get("iters") or raft_model.ITERS))
+        raw = args.get("iters")
+        iters = raft_model.ITERS if raw is None else int(raw)
+        if iters < 1:
+            raise ValueError(
+                f"iters={iters}: RAFT needs at least one GRU refinement "
+                "iteration")
+        self.model = raft_model.RAFT(iters=iters)
         params = store.resolve_params(
             f"raft_{finetuned_on}", raft_model.init_params,
             raft_model.params_from_torch,

@@ -80,6 +80,37 @@ def test_vit_clip_matches_reference_torch():
     np.testing.assert_allclose(got_txt, want_txt, atol=2e-5, rtol=1e-4)
 
 
+def test_vision_attn_blockwise_matches_dense():
+    """vision_attn=blockwise (streaming-softmax attention, block 256 over
+    the patch tokens) must reproduce the dense tower bit-for-bit-close; the
+    opt-in exists for the 577-token ViT-L/14@336 tower where the dense
+    per-layer score tensor dominates activation memory."""
+    import jax
+    cfg = _flax_cfg(32, 56, 2, 64, 14, 64, 2, 2, 12, 128)
+    dense = clip_model.CLIP(cfg)
+    blockwise = clip_model.CLIP(cfg, vision_attn="blockwise")
+    params = dense.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 56, 56, 3)),
+                        jnp.zeros((1, 12), jnp.int32))["params"]
+    rng = np.random.default_rng(2)
+    img = jnp.asarray(rng.normal(size=(3, 56, 56, 3)).astype(np.float32))
+    want = np.asarray(dense.apply({"params": params}, img,
+                                  method="encode_image"))
+    got = np.asarray(blockwise.apply({"params": params}, img,
+                                     method="encode_image"))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+    # blockwise boundary actually exercised: token count above one block
+    big = clip_model.VisionTransformer(width=64, layers=1, patch_size=2,
+                                       output_dim=16, attn_impl="blockwise")
+    small = clip_model.VisionTransformer(width=64, layers=1, patch_size=2,
+                                         output_dim=16)
+    x = jnp.asarray(rng.normal(size=(2, 48, 48, 3)).astype(np.float32))
+    p = small.init(jax.random.PRNGKey(1), x)["params"]  # 577 tokens
+    np.testing.assert_allclose(
+        np.asarray(big.apply({"params": p}, x)),
+        np.asarray(small.apply({"params": p}, x)), atol=2e-5, rtol=1e-5)
+
+
 def test_modified_resnet_clip_matches_reference_torch():
     ref = _load_ref("model.py", "ref_clip_model")
     torch.manual_seed(2)

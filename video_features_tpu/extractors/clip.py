@@ -60,6 +60,15 @@ class ExtractCLIP(FrameWiseExtractor):
         super().__init__(args)
         allow_random = bool(args.get("allow_random_weights", False))
         weights_path = args.get("weights_path")
+        # vision_attn=blockwise: streaming-softmax attention in the vision
+        # tower (models/clip.py MHA) — opt-in for the big-token towers
+        # (ViT-L/14@336 runs 577 patch tokens) where the dense per-layer
+        # (B*H, T, T) score tensor dominates activation memory. Values are
+        # identical; the text tower always stays dense (77 tokens).
+        vision_attn = str(args.get("vision_attn") or "dense")
+        if vision_attn not in ("dense", "blockwise"):
+            raise ValueError(f"vision_attn={vision_attn!r}: expected "
+                             "'dense' or 'blockwise'")
 
         if self.model_name == "custom":
             # architecture comes from the checkpoint itself
@@ -71,10 +80,10 @@ class ExtractCLIP(FrameWiseExtractor):
             sd = load_torch_state_dict(weights_path)
             self.cfg = clip_model.config_from_state_dict(sd)
             params = clip_model.params_from_torch(sd)
-            self.model = clip_model.CLIP(self.cfg)
+            self.model = clip_model.CLIP(self.cfg, vision_attn=vision_attn)
         elif self.model_name in clip_model.CONFIGS:
             self.cfg = clip_model.CONFIGS[self.model_name]
-            self.model = clip_model.CLIP(self.cfg)
+            self.model = clip_model.CLIP(self.cfg, vision_attn=vision_attn)
             params = store.resolve_params(
                 model_key(self.model_name),
                 partial(clip_model.init_params, self.model_name),
@@ -82,6 +91,14 @@ class ExtractCLIP(FrameWiseExtractor):
                 weights_path=weights_path, allow_random=allow_random)
         else:
             raise NotImplementedError(f"Model {self.model_name} not found")
+        if vision_attn == "blockwise" and not self.cfg.is_vit:
+            # only the ViT towers route attn_impl (models/clip.py CLIP.setup);
+            # a silent no-op on RN* would betray the documented contract
+            raise ValueError(
+                f"vision_attn=blockwise requires a ViT vision tower; "
+                f"{self.model_name} uses the modified-ResNet trunk whose "
+                "only attention is the 50-token AttentionPool2d head "
+                "(nothing to blockwise)")
 
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
         # model_parallel=N: 2-D (data, model) mesh with Megatron-style

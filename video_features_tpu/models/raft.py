@@ -407,7 +407,17 @@ class RAFT(nn.Module):
     pyramid (f32-accumulated einsum), the lookup, the iterated coords, norm
     statistics, and the upsample softmax. Flow drift vs f32 is sub-0.1 px
     (well under the I3D flow stream's ToUInt8 quantization step of ~0.16);
-    the f32 default is bit-identical to before (every cast is a no-op)."""
+    the f32 default is bit-identical to before (every cast is a no-op).
+
+    Precision/perf record: bf16 mode measured +7.5% on the I3D RGB+Flow
+    step in round 3 (3.95 -> 4.25 stacks/s, v5e) — the conv stacks go
+    MXU-native while the lookup cost is unchanged (it is selection-bound,
+    kernels/corr_lookup.py). A bf16 corr PYRAMID was measured twice and
+    rejected twice: 0.87x in round 2 (in-kernel upcast outweighed the DMA
+    saving), and moot in round 3 — the lane-dense repack proved lookup
+    DMA bytes are not the binding constraint at all, so halving them buys
+    nothing. The pyramid stays f32 in every mode, which also keeps lookup
+    values exact."""
     iters: int = ITERS
     dtype: Any = jnp.float32
 

@@ -1,0 +1,285 @@
+"""Checkpoint-FORMAT fidelity: every family's loader parses a file in its
+NATIVE on-disk format.
+
+Real pretrained blobs are unavailable in this zero-egress image, but the
+*formats* are synthesizable today from the reference's own torch classes
+with random weights — a real TorchScript archive for CLIP (the OpenAI CDN
+ships JIT archives, reference clip_src/clip.py:128-139), ``module.``-
+prefixed DataParallel checkpoints for the flow nets (reference
+base_flow_extractor.py:132-134 strips the prefix), torchvision / ig65m hub
+``.pth`` layouts for R(2+1)D (reference extract_r21d.py:105-113), the
+repo-local ``.pt`` state_dicts for I3D/S3D/PWC, and the torchvggish
+release ``.pth`` + PCA ``.npz`` (reference vggish_postprocess.py:22-91).
+
+Chain of evidence: each family's oracle test (test_raft, test_i3d,
+test_s3d, test_pwc, test_clip, test_r21d, test_vggish, test_resnet)
+already proves in-memory ``state_dict -> flax tree -> forward`` parity
+against the reference's own torch source. These tests prove
+``native file -> load_torch_state_dict -> flax tree`` equals that
+in-memory tree leaf-for-leaf — through the full production path
+(store.find_checkpoint filename probing, resolve_params, msgpack cache
+round-trip) — which closes the loop file -> forward for every family.
+The trickiest parse (the CLIP TorchScript archive, whose architecture is
+also INFERRED from the file, clip_src/model.py:399-436) additionally runs
+a full file -> forward -> torch-oracle comparison.
+"""
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from video_features_tpu.weights import store  # noqa: E402
+from video_features_tpu.weights.converters import registry  # noqa: E402
+from tests.torch_oracles import (TorchR2Plus1D, TorchVGGish,  # noqa: E402
+                                 randomize_bn_stats)
+
+REF_ROOT = Path("/root/reference")
+
+
+def _load_ref_module(name: str, rel: str):
+    path = REF_ROOT / rel
+    if not path.exists():
+        pytest.skip(f"reference source not available: {path}")
+    if str(REF_ROOT) not in sys.path:
+        # reference modules import through the 'models.*' package path
+        sys.path.insert(0, str(REF_ROOT))
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _assert_trees_equal(got, want, key):
+    import jax
+    gl, gt = jax.tree_util.tree_flatten_with_path(got)
+    wl, _ = jax.tree_util.tree_flatten_with_path(want)
+    assert len(gl) == len(wl), f"{key}: leaf count differs"
+    for (gp, gv), (wp, wv) in zip(gl, wl):
+        assert gp == wp, f"{key}: tree paths diverge at {gp} vs {wp}"
+        np.testing.assert_array_equal(
+            np.asarray(gv), np.asarray(wv),
+            err_msg=f"{key}: leaf {jax.tree_util.keystr(gp)}")
+
+
+def _resolve_native(monkeypatch, tmp_path, model_key, filename, save_fn):
+    """Full production load path: drop the native-format file under its
+    upstream FILENAME into the weights dir, resolve through
+    find_checkpoint's filename probing + the registered converter, verify
+    the msgpack cache round-trips, and return the loaded tree."""
+    wd = tmp_path / "weights"
+    wd.mkdir()
+    save_fn(wd / filename)
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(wd))
+    # find_checkpoint probes the torch hub cache FIRST for hub filenames;
+    # isolate it so a host's real cached checkpoint can't shadow the
+    # synthesized oracle file
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    init_fn, convert_fn = registry()[model_key]
+    found = store.find_checkpoint(model_key)
+    assert found is not None and found.name == filename, \
+        f"find_checkpoint missed the native filename {filename!r}: {found}"
+    params = store.resolve_params(model_key, init_fn, convert_fn)
+    cache = wd / f"{model_key}.msgpack"
+    assert cache.exists(), "resolve_params did not write the msgpack cache"
+    cached = store.load_msgpack(init_fn(), cache)
+    _assert_trees_equal(cached, params, f"{model_key} msgpack round-trip")
+    return params
+
+
+# ---- flow nets: module.-prefixed DataParallel checkpoints ----------------
+
+def test_raft_module_prefixed_ckpt(monkeypatch, tmp_path):
+    """raft-sintel.pth as the reference ships it: an OrderedDict whose keys
+    carry the nn.DataParallel 'module.' prefix (base_flow_extractor.py:
+    132-134)."""
+    from video_features_tpu.models import raft as raft_m
+    ref_raft = _load_ref_module("ref_raft_fmt", "models/raft/raft_src/raft.py")
+    torch.manual_seed(0)
+    oracle = ref_raft.RAFT().eval()
+    randomize_bn_stats(oracle)
+    sd = {f"module.{k}": v for k, v in oracle.state_dict().items()}
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, "raft_sintel", "raft-sintel.pth",
+        lambda p: torch.save(sd, p))
+    want = raft_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, "raft_sintel")
+
+
+def test_pwc_module_prefixed_ckpt(monkeypatch, tmp_path):
+    """pwc_net_sintel.pt: module.-prefixed state_dict, same DataParallel
+    convention (the reference loads both flow nets through the same
+    strip)."""
+    from video_features_tpu.models import pwc as pwc_m
+    from tests.test_pwc import _load_reference_pwc
+    ref = _load_reference_pwc()
+    torch.manual_seed(0)
+    oracle = ref.PWCNet().eval()
+    sd = {f"module.{k}": v for k, v in oracle.state_dict().items()}
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, "pwc_sintel", "pwc_net_sintel.pt",
+        lambda p: torch.save(sd, p))
+    want = pwc_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, "pwc_sintel")
+
+
+# ---- repo-local .pt state_dicts ------------------------------------------
+
+@pytest.mark.parametrize("modality", ["rgb", "flow"])
+def test_i3d_repo_local_pt(monkeypatch, tmp_path, modality):
+    """i3d_rgb.pt / i3d_flow.pt: plain state_dicts of the reference I3D
+    class (models/i3d/checkpoints)."""
+    from video_features_tpu.models import i3d as i3d_m
+    ref = _load_ref_module("ref_i3d_fmt", "models/i3d/i3d_src/i3d_net.py")
+    torch.manual_seed(0)
+    oracle = ref.I3D(num_classes=400, modality=modality).eval()
+    randomize_bn_stats(oracle)
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, f"i3d_{modality}", f"i3d_{modality}.pt",
+        lambda p: torch.save(oracle.state_dict(), p))
+    want = i3d_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, f"i3d_{modality}")
+
+
+def test_s3d_torchified_ckpt(monkeypatch, tmp_path):
+    """S3D_kinetics400_torchified.pt: state_dict of the reference S3D class
+    (converted-from-TF release the reference repo carries)."""
+    from video_features_tpu.models import s3d as s3d_m
+    ref = _load_ref_module("ref_s3d_fmt", "models/s3d/s3d_src/s3d.py")
+    torch.manual_seed(0)
+    oracle = ref.S3D(num_class=400).eval()
+    randomize_bn_stats(oracle)
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, "s3d_kinetics400",
+        "S3D_kinetics400_torchified.pt",
+        lambda p: torch.save(oracle.state_dict(), p))
+    want = s3d_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, "s3d_kinetics400")
+
+
+# ---- hub .pth layouts ----------------------------------------------------
+
+@pytest.mark.parametrize("model_key,layers,filename", [
+    ("r2plus1d_18_16_kinetics", (2, 2, 2, 2), "r2plus1d_18-91a641e6.pth"),
+    # the ig65m hub checkpoints are torchvision-VideoResNet-shaped with the
+    # 34-layer block plan (reference extract_r21d.py:105-113 pulls them via
+    # torch.hub from moabitcoin/ig65m-pytorch)
+    ("r2plus1d_34_32_ig65m_ft_kinetics", (3, 4, 6, 3),
+     "r2plus1d_34_clip32_ig65m_from_scratch-449a7af9.pth"),
+])
+def test_r21d_hub_pth_layouts(monkeypatch, tmp_path, model_key, layers,
+                              filename):
+    from video_features_tpu.models import r21d as r21d_m
+    torch.manual_seed(0)
+    num_classes = 400 if layers == (2, 2, 2, 2) else 359
+    oracle = TorchR2Plus1D(layers=layers, num_classes=num_classes).eval()
+    randomize_bn_stats(oracle)
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, model_key, filename,
+        lambda p: torch.save(oracle.state_dict(), p))
+    want = r21d_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, model_key)
+
+
+# ---- torchvggish release + PCA params ------------------------------------
+
+def test_vggish_release_pth(monkeypatch, tmp_path):
+    from video_features_tpu.models import vggish as vggish_m
+    torch.manual_seed(0)
+    oracle = TorchVGGish().eval()
+
+    params = _resolve_native(
+        monkeypatch, tmp_path, "vggish", "vggish-10086976.pth",
+        lambda p: torch.save(oracle.state_dict(), p))
+    want = vggish_m.params_from_torch(oracle.state_dict())
+    _assert_trees_equal(params, want, "vggish")
+
+
+@pytest.mark.parametrize("kind", ["npz", "pth"])
+def test_vggish_pca_formats(monkeypatch, tmp_path, kind):
+    """The PCA postprocessor params in both native containers: the
+    reference repo's .npz (vggish_postprocess.py:22-32 reads
+    'pca_eigen_vectors'/'pca_means') and the torchvggish release .pth (a
+    pickled dict of the same arrays)."""
+    from video_features_tpu.models.vggish import load_pca_params, postprocess
+    rng = np.random.default_rng(0)
+    vectors = rng.standard_normal((128, 128)).astype(np.float32)
+    means = rng.standard_normal((128,)).astype(np.float32)
+    wd = tmp_path / "weights"
+    wd.mkdir()
+    if kind == "npz":
+        path = wd / "vggish_pca_params.npz"
+        np.savez(path, pca_eigen_vectors=vectors, pca_means=means)
+    else:
+        path = wd / "vggish_pca_params-970ea276.pth"
+        torch.save({"pca_eigen_vectors": torch.from_numpy(vectors),
+                    "pca_means": torch.from_numpy(means)}, path)
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(wd))
+    found = store.find_checkpoint("vggish_pca")
+    assert found is not None and found.name == path.name
+    got_v, got_m = load_pca_params(str(found))
+    np.testing.assert_array_equal(got_v, vectors)
+    np.testing.assert_array_equal(got_m, means.reshape(-1, 1))
+    # and the postprocess consumes them. Contract note: the reference
+    # PIPELINE uses the torchvggish Postprocessor (vggish_slim.py:63-92:
+    # round, squeeze, float output) — NOT the repo's unused numpy
+    # vggish_postprocess.py variant (truncate + uint8 cast); this build
+    # matches the one actually executed (test_vggish pins the math).
+    emb = rng.standard_normal((3, 128)).astype(np.float32)
+    out = postprocess(emb, got_v, got_m)
+    assert out.shape == (3, 128)
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 255.0
+
+
+# ---- CLIP: TorchScript archive, architecture inferred from the file ------
+
+def test_clip_torchscript_archive_full_chain(monkeypatch, tmp_path):
+    """A real torch.jit archive of the reference CLIP class (the OpenAI CDN
+    format; reference clip_src/clip.py:128-139 tries jit.load first), on a
+    tiny ViT config. Full chain: archive -> load_torch_state_dict unwrap ->
+    config_from_state_dict architecture inference -> params_from_torch ->
+    forward, compared against the torch oracle's own forward."""
+    from video_features_tpu.models import clip as clip_model
+    from video_features_tpu.weights.torch_import import load_torch_state_dict
+    ref = _load_ref_module("ref_clip_fmt", "models/clip/clip_src/model.py")
+    torch.manual_seed(0)
+    oracle = ref.CLIP(embed_dim=32, image_resolution=56, vision_layers=2,
+                      vision_width=64, vision_patch_size=14,
+                      context_length=12, vocab_size=128,
+                      transformer_width=64, transformer_heads=2,
+                      transformer_layers=2).eval()
+    path = tmp_path / "ViT-tiny.pt"
+    try:
+        scripted = torch.jit.script(oracle)
+    except Exception:
+        img = torch.zeros(1, 3, 56, 56)
+        toks = torch.zeros(1, 12, dtype=torch.long)
+        scripted = torch.jit.trace(oracle, (img, toks))
+    scripted.save(str(path))
+
+    sd = load_torch_state_dict(str(path))
+    cfg = clip_model.config_from_state_dict(sd)
+    assert (cfg.embed_dim, cfg.image_resolution, cfg.vision_layers,
+            cfg.vision_patch_size) == (32, 56, 2, 14), cfg
+    params = clip_model.params_from_torch(sd)
+    model = clip_model.CLIP(cfg)
+
+    rng = np.random.default_rng(1)
+    img = rng.normal(size=(2, 56, 56, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = oracle.encode_image(
+            torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(img),
+                                 method="encode_image"))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)

@@ -184,6 +184,47 @@ def bench_i3d_ours(stack: int = I3D_STACK, iters: int = 10,
     return best
 
 
+def bench_pipeline(n_copies: int = 8) -> dict:
+    """Sustained REAL-pipeline throughput: decode -> transform -> device ->
+    sink, through the actual CLI driver, on ``n_copies`` of the vendored
+    sample video — the deliverable number next to the device-only steady
+    state (which assumes decode keeps up). Uses the headline device config
+    (yuv420 ingest, bf16, clip_batch_size=128) with cross-video batching,
+    so short videos can actually fill the B=128 groups the device number
+    is measured at. On a few-core host this is decode-bound — that gap IS
+    the measurement."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the pipeline bench")
+    from video_features_tpu.cli import main as cli_main
+    with tempfile.TemporaryDirectory(prefix="vft_bench_pipe_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_copy{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+        t0 = time.perf_counter()
+        cli_main([
+            "feature_type=r21d", "precision=bfloat16", "ingest=yuv420",
+            "clip_batch_size=128", "cross_video_batching=true",
+            "video_workers=auto", "allow_random_weights=true",
+            "on_extraction=save_numpy", f"output_path={td}/out",
+            f"tmp_path={td}/tmp",
+            "video_paths=[" + ",".join(vids) + "]",
+        ])
+        wall = time.perf_counter() - t0
+        clips = sum(np.load(p).shape[0]
+                    for p in Path(td, "out").rglob("*_r21d.npy"))
+    return {"videos_per_s": n_copies / wall, "clips_per_s": clips / wall,
+            "clips": clips, "wall_s": wall}
+
+
 def bench_i3d_torch(stack: int = I3D_STACK) -> float:
     """The full reference-shaped stack unit in torch on this host's CPU:
     RAFT flow on the frame pairs PLUS both I3D tower forwards (all classes
@@ -305,8 +346,26 @@ def main() -> None:
             "unit": "stacks/sec/chip",
             "vs_baseline": round(ratio, 2) if ratio is not None else None,
         })
+    # sustained real-pipeline number (decode -> device -> sink): the
+    # deliverable throughput next to the device-only steady state;
+    # wall-clock includes the one-time compile when the persistent cache
+    # is cold, so cache warmth (the two device benches above) matters
+    try:
+        pipe = bench_pipeline()
+        metrics.append({
+            "metric": "r2plus1d_18 sustained pipeline decode->device->sink "
+                      "(8x sample video, yuv420+bf16, cross-video B=128; "
+                      f"{pipe['videos_per_s']:.2f} videos/s)",
+            "value": round(pipe["clips_per_s"], 2),
+            "unit": "clips/sec",
+            "vs_baseline": None,
+        })
+    except Exception as e:
+        print(f"WARNING: pipeline bench failed: {type(e).__name__}: {e}",
+              file=__import__("sys").stderr)
+
     # one JSON line: headline fields stay the r21d config (driver contract
-    # since round 1); "metrics" carries both north-star configs
+    # since round 1); "metrics" carries the north-star configs + pipeline
     print(json.dumps({**r21d_entry, "metrics": metrics}))
 
 

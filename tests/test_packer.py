@@ -1,0 +1,173 @@
+"""Cross-video clip batching (parallel/packer.py + clip_stack wiring).
+
+The packer's contract: per-video results identical to the per-video-stream
+path, any thread interleaving, no deadlock when every worker closes at
+once with a part-filled group."""
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from video_features_tpu.parallel.packer import ClipPacker
+
+
+class FakeRunner:
+    """Row-wise 'device' forward: mean over all but the leading axis, with
+    a jitter delay so drain/dispatch interleavings actually vary."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.groups = []
+
+    def dispatch(self, group: np.ndarray) -> np.ndarray:
+        if self.delay:
+            time.sleep(self.delay)
+        self.groups.append(group.shape[0])
+        return group.reshape(group.shape[0], -1).mean(axis=1, keepdims=True)
+
+
+def _stack(video: int, idx: int) -> np.ndarray:
+    # identifiable content: the fake forward recovers video*1000 + idx
+    return np.full((4, 8, 8, 3), float(video * 1000 + idx), np.float32)
+
+
+def test_single_video_ragged_flush():
+    """One video, fewer clips than the batch: the all-closing flush rule
+    must dispatch the ragged group instead of deadlocking."""
+    runner = FakeRunner()
+    p = ClipPacker(runner, batch=8)
+    h = p.open_video()
+    for i in range(3):
+        p.add(h, _stack(0, i))
+    rows = p.close_video(h)
+    assert rows.shape == (3, 1)
+    np.testing.assert_array_equal(rows[:, 0], [0.0, 1.0, 2.0])
+    assert runner.groups == [3]  # one ragged dispatch, at close
+
+
+def test_groups_fill_across_videos():
+    """Sequential adds from two videos share one full-size group."""
+    runner = FakeRunner()
+    p = ClipPacker(runner, batch=4)
+    h1, h2 = p.open_video(), p.open_video()
+    p.add(h1, _stack(1, 0))
+    p.add(h2, _stack(2, 0))
+    p.add(h1, _stack(1, 1))
+    p.add(h2, _stack(2, 1))  # fills -> dispatches a packed group
+    assert runner.groups == [4]
+    r1 = p.close_video(h1)
+    np.testing.assert_array_equal(r1[:, 0], [1000.0, 1001.0])
+    r2 = p.close_video(h2)
+    np.testing.assert_array_equal(r2[:, 0], [2000.0, 2001.0])
+
+
+def test_empty_video():
+    p = ClipPacker(FakeRunner(), batch=4)
+    h = p.open_video()
+    assert p.close_video(h).shape == (0,)
+
+
+def test_abort_unwedges_closers():
+    """Per-video error isolation: a video that dies after open_video must
+    not leave the open count elevated — otherwise the all-closing flush
+    rule can never fire and every other worker's close_video hangs."""
+    runner = FakeRunner()
+    p = ClipPacker(runner, batch=8)
+    healthy, doomed = p.open_video(), p.open_video()
+    p.add(healthy, _stack(1, 0))
+    p.add(doomed, _stack(2, 0))
+    p.abort_video(doomed)  # what the extractor's except-path calls
+    done = []
+
+    def close_healthy():
+        done.append(p.close_video(healthy))
+
+    t = threading.Thread(target=close_healthy)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "close_video wedged after a peer aborted"
+    np.testing.assert_array_equal(done[0][:, 0], [1000.0])
+    # the aborted video's buffered clip was discarded, not computed: the
+    # ragged flush carried only the healthy video's single clip
+    assert runner.groups == [1]
+
+
+@pytest.mark.parametrize("batch,workers", [(4, 4), (8, 3)])
+def test_concurrent_videos_exact_rows(batch, workers):
+    """Many threads, ragged per-video clip counts (including zero), slow
+    fake device: every video gets exactly its rows, in clip order."""
+    runner = FakeRunner(delay=0.002)
+    p = ClipPacker(runner, batch=batch, depth=2)
+    rng = np.random.default_rng(0)
+    counts = [int(c) for c in rng.integers(0, 6, size=10)]
+
+    def run_video(vid: int) -> np.ndarray:
+        h = p.open_video()
+        for i in range(counts[vid]):
+            p.add(h, _stack(vid, i))
+            time.sleep(0.001 * (vid % 3))
+        return p.close_video(h)
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        results = list(pool.map(run_video, range(len(counts))))
+    for vid, rows in enumerate(results):
+        assert rows.shape[0] == counts[vid], (vid, rows.shape)
+        if counts[vid]:
+            np.testing.assert_array_equal(
+                rows[:, 0], [vid * 1000 + i for i in range(counts[vid])])
+    # conservation: every clip dispatched exactly once
+    assert sum(runner.groups) == sum(counts)
+
+
+def _write_clip(path: str, frames: int, seed: int) -> str:
+    cv2 = pytest.importorskip("cv2")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),
+                        16.0, (64, 48))
+    if not w.isOpened():
+        pytest.skip("cv2 cannot encode mp4v")
+    yy, xx = np.mgrid[0:48, 0:64].astype(np.float32)
+    for t in range(frames):
+        frame = np.stack([
+            127 + 120 * np.sin(xx / 9 + t / 5 + seed),
+            127 + 120 * np.sin(yy / 7 - t / 6 + 2 * seed),
+            127 + 120 * np.sin((xx + yy) / 11 + t / 4 + 3 * seed),
+        ], axis=-1)
+        w.write(frame.clip(0, 255).astype(np.uint8))
+    w.release()
+    return path
+
+
+def test_r21d_cross_video_outputs_identical(tmp_path):
+    """E2E through the real extractor: cross_video_batching=true over
+    several short videos (each well under one clip_batch_size group) must
+    write byte-identical features to the unpacked path, independent of
+    worker interleaving."""
+    from video_features_tpu.cli import main
+
+    vids = [_write_clip(str(tmp_path / f"v{i}.mp4"), 40 + 16 * i, i)
+            for i in range(3)]
+
+    def run(out, packed, workers):
+        main([
+            "feature_type=r21d", "device=cpu", "allow_random_weights=true",
+            "on_extraction=save_numpy", f"output_path={tmp_path / out}",
+            f"tmp_path={tmp_path / ('tmp_' + out)}", "clip_batch_size=8",
+            f"video_workers={workers}",
+            f"cross_video_batching={'true' if packed else 'false'}",
+            "video_paths=[" + ",".join(vids) + "]",
+        ])
+        return {
+            p.name: np.load(p)
+            for p in sorted((tmp_path / out).rglob("*_r21d.npy"))
+        }
+
+    plain = run("plain", packed=False, workers=1)
+    packed = run("packed", packed=True, workers=2)
+    assert set(plain) == set(packed) and len(plain) == 3
+    for name in plain:
+        assert plain[name].shape == packed[name].shape, name
+        np.testing.assert_allclose(packed[name], plain[name],
+                                   atol=1e-5, rtol=1e-5, err_msg=name)

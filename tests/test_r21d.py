@@ -106,10 +106,18 @@ def test_streaming_path_matches_buffered(sample_video, tmp_path):
                            fps=ex.extraction_fps,
                            transform=ex.host_transform)
 
-    streamed = ex._extract_streaming(make_src())["r21d"]
-    buffered = ex._extract_buffered(make_src())["r21d"]
-    assert streamed.shape == buffered.shape and streamed.shape[0] > 0
-    np.testing.assert_allclose(streamed, buffered, atol=1e-6, rtol=1e-6)
+    # the streaming window former (disjoint regime, frames dropped as
+    # decoded) must produce exactly the windows form_slices prescribes over
+    # the materialized sequence — the buffered regime's ground truth
+    from video_features_tpu.utils.lists import form_slices
+    frames = [f for f, _, _ in make_src().frames()]
+    want_windows = form_slices(len(frames), ex.stack_size, ex.step_size)
+    got = list(ex._iter_stacks(make_src()))
+    assert [w for w, _ in got] == want_windows and len(got) > 0
+    for (s, e), stack in got:
+        np.testing.assert_array_equal(stack, np.stack(frames[s:e]))
+    extracted = ex._extract_grouped(make_src())["r21d"]
+    assert extracted.shape[0] == len(want_windows)
 
 
 def test_show_pred_windows_through_streaming(sample_video, tmp_path, capsys):

@@ -18,6 +18,7 @@ executable per (stack_size, H, W) is compiled.
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -52,6 +53,21 @@ class ClipStackExtractor(BaseExtractor):
         self.runner: Optional[DataParallelApply] = None
         self.ingest = self._resolve_ingest(
             args, "uint8" if self.precision == "bfloat16" else "float32")
+        # cross_video_batching=true: ONE clip buffer shared across the
+        # video_workers threads, so device groups dispatch only when FULL
+        # (parallel/packer.py) — lifts sustained throughput on short-video
+        # corpora toward the fixed-shape bench steady state and makes big
+        # clip_batch_size (128 is the v5e sweet spot) practical there.
+        # Per-video outputs are identical to the unpacked path (row-wise
+        # forward; asserted in tests/test_packer.py).
+        self.cross_video = bool(args.get("cross_video_batching", False))
+        if self.cross_video and self.show_pred:
+            raise NotImplementedError(
+                "cross_video_batching=true is incompatible with "
+                "show_pred=true (predictions print per video group; packed "
+                "groups interleave videos)")
+        self._packer = None
+        self._packer_lock = threading.Lock()
 
     def encode_wire(self, x01: np.ndarray) -> np.ndarray:
         """[0, 1] float HWC frame -> the configured wire format (the tail of
@@ -64,63 +80,48 @@ class ClipStackExtractor(BaseExtractor):
             return u8
         return colorspace.rgb_to_yuv420(u8)
 
+    def _get_packer(self):
+        from ..parallel.packer import ClipPacker
+        with self._packer_lock:
+            if self._packer is None:
+                self._packer = ClipPacker(self.runner,
+                                          batch=self.clip_batch_size)
+            return self._packer
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
                           transform=self.host_transform)
-        if self.step_size >= self.stack_size:
-            # non-overlapping windows (the default for every family): stream
-            # — bounded host memory (one device group of frames, not the
-            # whole video) and decode overlapped with device compute. The
-            # reference reads the whole video up front and warns "could run
-            # out of memory here" (extract_r21d.py:75-77).
-            return self._extract_streaming(src)
-        return self._extract_buffered(src)
+        if self.cross_video:
+            return self._extract_packed(src)
+        return self._extract_grouped(src)
 
-    def _extract_buffered(self, src: VideoSource) -> Dict[str, np.ndarray]:
-        """Overlapping windows (step < stack): every frame participates in
-        several windows, so the full frame sequence is materialized and
-        windows are sliced out of it group by group."""
-        frames = [f for f, _, _ in src.frames()]
-        slices = form_slices(len(frames), self.stack_size, self.step_size)
-        vid_feats: List[np.ndarray] = []
-        stream = self._make_stream()
-        if slices:
+    def _iter_stacks(self, src: VideoSource):
+        """Yield ((start, end), (stack, *frame_wire_shape)) clip windows
+        under the form_slices drop-partial contract (reference
+        utils/utils.py:59-68), one window at a time:
+
+          - step >= stack (every family's default): disjoint windows are
+            formed on the fly — frames between windows are dropped as
+            decoded, and the Prefetcher's decode-ahead thread overlaps the
+            consumer (bounded host memory; the reference reads the whole
+            video up front and warns "could run out of memory here",
+            extract_r21d.py:75-77);
+          - step < stack: every frame participates in several windows, so
+            the full frame sequence is materialized and windows are sliced
+            from it (yielding per window keeps peak memory at sequence +
+            one group, not sequence x stack/step)."""
+        if self.step_size < self.stack_size:
+            frames = [f for f, _, _ in src.frames()]
+            if not frames:
+                return
             all_frames = np.stack(frames)  # (T, *frame_wire_shape)
-            for i in range(0, len(slices), self.clip_batch_size):
-                # materialize only this group's windows: with overlapping
-                # windows (step < stack) stacking all of them up front would
-                # multiply peak host memory by stack_size/step_size
-                window = slices[i:i + self.clip_batch_size]
-                group = np.stack([all_frames[s:e] for s, e in window])
-                # async dispatch (parallel/mesh.py FeatureStream): window
-                # assembly of group k+1 overlaps device compute of k
-                stream.submit(group, ctx=(window, group))
-        for feats in stream.finish():
-            vid_feats.extend(list(feats))
-        return {self.feature_type: np.array(vid_feats)}
-
-    def _extract_streaming(self, src: VideoSource) -> Dict[str, np.ndarray]:
-        """step >= stack: windows are disjoint, so stacks are formed on the
-        fly — frames between windows (step > stack) are dropped as decoded;
-        groups are dispatched asynchronously (submit returns immediately;
-        only a depth-overflow pop or the final finish() blocks on D2H), so
-        the Prefetcher's decode-ahead thread and the device overlap freely.
-        Same observable contract as the buffered path: form_slices
-        drop-partial semantics."""
+            for s, e in form_slices(len(frames), self.stack_size,
+                                    self.step_size):
+                yield (s, e), all_frames[s:e]
+            return
         gap = self.step_size - self.stack_size
-        vid_feats: List[np.ndarray] = []
-        stacks: List[np.ndarray] = []
-        windows: List = []
         current: List[np.ndarray] = []
         start_idx = 0
-        stream = self._make_stream()
-
-        def flush():
-            group = np.stack(stacks)
-            stream.submit(group, ctx=(list(windows), group))
-            stacks.clear()
-            windows.clear()
-
         until_next = 0  # frames to drop before the next window starts
         for f, _, idx in Prefetcher(src.frames()):
             if until_next > 0:
@@ -130,19 +131,55 @@ class ClipStackExtractor(BaseExtractor):
                 start_idx = idx
             current.append(f)
             if len(current) == self.stack_size:
-                stacks.append(np.stack(current))
-                windows.append((start_idx, start_idx + self.stack_size))
+                yield (start_idx, start_idx + self.stack_size), \
+                    np.stack(current)
                 current.clear()
                 until_next = gap
-                if len(stacks) == self.clip_batch_size:
-                    flush()
-        # trailing partial stack dropped (reference utils/utils.py:59-68);
-        # trailing complete stacks still flush as a ragged (padded) group
+        # a trailing partial stack is dropped by falling off the loop
+
+    def _extract_grouped(self, src: VideoSource) -> Dict[str, np.ndarray]:
+        """Per-video async groups: windows batch into clip_batch_size
+        groups dispatched through this video's own FeatureStream (submit
+        returns immediately; only a depth-overflow pop or the final
+        finish() blocks on D2H), so decode and device compute overlap. The
+        trailing group goes out ragged (padded on dispatch)."""
+        vid_feats: List[np.ndarray] = []
+        stacks: List[np.ndarray] = []
+        windows: List = []
+        stream = self._make_stream()
+
+        def flush():
+            group = np.stack(stacks)
+            stream.submit(group, ctx=(list(windows), group))
+            stacks.clear()
+            windows.clear()
+
+        for window, stack in self._iter_stacks(src):
+            windows.append(window)
+            stacks.append(stack)
+            if len(stacks) == self.clip_batch_size:
+                flush()
         if stacks:
             flush()
         for feats in stream.finish():
             vid_feats.extend(list(feats))
         return {self.feature_type: np.array(vid_feats)}
+
+    def _extract_packed(self, src: VideoSource) -> Dict[str, np.ndarray]:
+        """Cross-video group packing: clips go straight into the shared
+        packer (one per extractor, fed by all video_workers threads) and
+        come back per video in clip order; groups dispatch only when full
+        (parallel/packer.py). The abort path keeps per-video error
+        isolation from wedging other workers' close waits."""
+        packer = self._get_packer()
+        handle = packer.open_video()
+        try:
+            for _, stack in self._iter_stacks(src):
+                packer.add(handle, stack)
+        except BaseException:
+            packer.abort_video(handle)
+            raise
+        return {self.feature_type: packer.close_video(handle)}
 
     def _make_stream(self):
         return self.feature_stream(

@@ -1,0 +1,180 @@
+"""Cross-video clip batching: fill fixed-shape device groups from several
+videos' clips at once.
+
+Per-video async streams (parallel/mesh.py FeatureStream) dispatch each
+video's trailing group ragged — padded rows that burn device FLOPs. At the
+bench sweet spot (``clip_batch_size=128`` on v5e) the 18 s reference sample
+yields 22 clips, so 83% of a per-video flagship group would be padding and
+the measured steady state is unreachable on short-video corpora. The
+packer instead keeps ONE buffer shared by the ``video_workers`` decode
+threads: a device group dispatches only when FULL (the sole exception is
+the final drain, when every still-open video is already waiting to close),
+so sustained throughput approaches the fixed-shape bench steady state
+regardless of per-video clip counts.
+
+Ordering contract: results come back per video, in that video's clip
+order, bit-identical to the unpacked path — group membership only changes
+which padded rows surround a clip, and the row itself is independent of
+its neighbors (the forward is row-wise; parity asserted in
+tests/test_packer.py).
+
+Reference contrast: the reference's only cross-video parallelism is
+launching extra whole processes per GPU (reference README.md:70-84), each
+still running batch=1 slices; it has no batch packing of any kind.
+
+Concurrency design (all state under one lock; D2H copies outside it):
+
+  - ``add`` appends to the shared buffer; a full buffer dispatches the
+    jitted forward immediately (dispatch is async — enqueue only).
+  - ``close_video`` blocks until all of that video's clips have
+    materialized. Progress is guaranteed: whoever observes work in flight
+    drains the oldest group (a second lock keeps drains submit-ordered);
+    when every open video is simultaneously closing and clips still sit
+    in the unfilled buffer, the buffer is flushed ragged — so the system
+    cannot deadlock even when all ``video_workers`` threads close at once
+    with a part-filled group.
+  - ``depth`` bounds un-materialized device groups, same role as
+    FeatureStream's depth.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ClipPacker:
+    def __init__(self, runner, batch: int, depth: int = 4):
+        self.runner = runner
+        self.batch = int(batch)
+        self.depth = max(int(depth), 1)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._drain_lock = threading.Lock()     # serializes D2H
+        self._dispatch_lock = threading.Lock()  # serializes group dispatch
+        self._buf: List[tuple] = []          # [(handle, idx, stack), ...]
+        self._inflight: deque = deque()      # [(device_array, manifest)]
+        self._results: Dict[int, Dict[int, np.ndarray]] = {}
+        self._counts: Dict[int, int] = {}    # clips added per handle
+        self._pending: Dict[int, int] = {}   # clips not yet materialized
+        self._open = 0
+        self._closing = 0
+        self._next_handle = 0
+
+    # -- per-video API (each video's decode thread) ------------------------
+
+    def open_video(self) -> int:
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._results[h] = {}
+            self._counts[h] = 0
+            self._pending[h] = 0
+            self._open += 1
+            return h
+
+    def add(self, handle: int, stack: np.ndarray) -> None:
+        """Append one clip stack; dispatches when the shared group fills."""
+        to_dispatch = None
+        with self._lock:
+            self._buf.append((handle, self._counts[handle], stack))
+            self._counts[handle] += 1
+            self._pending[handle] += 1
+            if len(self._buf) >= self.batch:
+                to_dispatch, self._buf = self._buf, []
+        if to_dispatch is not None:
+            self._dispatch(to_dispatch)
+            with self._lock:
+                drain = len(self._inflight) > self.depth
+            if drain:
+                self._drain_oldest()
+
+    def abort_video(self, handle: int) -> None:
+        """Error-path cleanup (per-video isolation): discard the video's
+        buffered clips and stop counting it as open. Without this, a video
+        that dies after open_video() would leave ``_open`` elevated forever
+        and the all-closing flush rule could never fire — wedging every
+        other worker's close_video. Rows of its already-dispatched clips
+        are dropped at drain time (the results entry is gone)."""
+        with self._lock:
+            self._buf = [e for e in self._buf if e[0] != handle]
+            self._results.pop(handle, None)
+            self._counts.pop(handle, None)
+            self._pending.pop(handle, None)
+            self._open -= 1
+            self._cond.notify_all()
+
+    def close_video(self, handle: int) -> np.ndarray:
+        """Block until every clip of ``handle`` materialized; return the
+        (n_clips, ...) feature rows in add order."""
+        with self._lock:
+            self._closing += 1
+        try:
+            while True:
+                to_flush = None
+                with self._lock:
+                    # pending counts buffered AND in-flight clips, so zero
+                    # means everything of ours has materialized
+                    if self._pending[handle] == 0:
+                        break
+                    if not self._inflight:
+                        if self._buf and self._closing >= self._open:
+                            # every open video is closing: nobody will fill
+                            # the group — flush it ragged (the only ragged
+                            # dispatch in the system)
+                            to_flush, self._buf = self._buf, []
+                        else:
+                            # other videos are still decoding; their adds
+                            # will fill the buffer. The timeout guards the
+                            # race where the last feeder transitions to
+                            # closing between our check and the wait.
+                            self._cond.wait(timeout=0.05)
+                            continue
+                if to_flush is not None:
+                    self._dispatch(to_flush)
+                self._drain_oldest()
+        finally:
+            with self._lock:
+                self._closing -= 1
+                self._open -= 1
+                rows = self._results.pop(handle)
+                n = self._counts.pop(handle)
+                self._pending.pop(handle)
+        if n == 0:
+            return np.empty((0,), np.float32)
+        return np.stack([rows[i] for i in range(n)])
+
+    # -- internals ---------------------------------------------------------
+
+    def _dispatch(self, items: List[tuple]) -> None:
+        """Stack + enqueue a group WITHOUT the main lock held (the host
+        copy of a B=128 group is tens of MB — holding the lock there would
+        stall every decode thread). The dispatch lock keeps the inflight
+        order consistent with dispatch order."""
+        with self._dispatch_lock:
+            group = np.stack([s for _, _, s in items])
+            manifest = [(h, idx) for h, idx, _ in items]
+            dev = self.runner.dispatch(group)
+            with self._lock:
+                self._inflight.append((dev, manifest))
+                self._cond.notify_all()
+
+    def _drain_oldest(self) -> None:
+        """Materialize the oldest in-flight group (if any) and route its
+        rows to their videos. D2H happens outside the main lock so decode
+        threads keep feeding; the drain lock keeps materialization
+        submit-ordered."""
+        with self._drain_lock:
+            with self._lock:
+                if not self._inflight:
+                    return
+                dev, manifest = self._inflight.popleft()
+            host = np.asarray(dev)  # blocking D2H
+            with self._lock:
+                for row, (h, idx) in enumerate(manifest):
+                    if h in self._results:
+                        self._results[h][idx] = host[row]
+                        self._pending[h] -= 1
+                self._cond.notify_all()

@@ -140,6 +140,29 @@ def _write_clip(path: str, frames: int, seed: int) -> str:
     return path
 
 
+def test_cross_video_survives_corrupt_video(tmp_path):
+    """Per-video error isolation under packing, end to end: one unreadable
+    video among healthy ones must be reported failed while every healthy
+    video still completes (the packer abort path; without it the run
+    wedges in close_video)."""
+    from video_features_tpu.cli import main
+
+    vids = [_write_clip(str(tmp_path / f"v{i}.mp4"), 40, i) for i in range(2)]
+    bad = tmp_path / "broken.mp4"
+    bad.write_bytes(b"not a video at all")
+    vids.insert(1, str(bad))
+
+    main([
+        "feature_type=r21d", "device=cpu", "allow_random_weights=true",
+        "on_extraction=save_numpy", f"output_path={tmp_path / 'out'}",
+        f"tmp_path={tmp_path / 'tmp'}", "clip_batch_size=8",
+        "video_workers=2", "cross_video_batching=true",
+        "video_paths=[" + ",".join(vids) + "]",
+    ])
+    done = sorted(p.name for p in (tmp_path / "out").rglob("*_r21d.npy"))
+    assert done == ["v0_r21d.npy", "v1_r21d.npy"], done
+
+
 def test_r21d_cross_video_outputs_identical(tmp_path):
     """E2E through the real extractor: cross_video_batching=true over
     several short videos (each well under one clip_batch_size group) must

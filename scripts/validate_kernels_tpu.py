@@ -106,11 +106,18 @@ def check_corr_lookup() -> list:
             ref = np.asarray(corr_lookup_gather(pyr, coords))
             pal = np.asarray(corr_lookup_pallas(pyr, coords))
             one = np.asarray(corr_lookup_onehot(pyr, coords))
+            # the lane-dense packed twin (VFT_CORR_LOOKUP=packed, the
+            # retained negative-result kernel) must stay hardware-clean too
+            from video_features_tpu.kernels.corr_lookup import (
+                corr_lookup_packed, pack_pyramid)
+            packed, metas = pack_pyramid(pyr)
+            pk = np.asarray(corr_lookup_packed(packed, metas, coords))
             ep = float(np.max(np.abs(pal - ref)))
             eo = float(np.max(np.abs(one - ref)))
-            ok = ep < 1e-4 and eo < 1e-4
+            ek = float(np.max(np.abs(pk - ref)))
+            ok = ep < 1e-4 and eo < 1e-4 and ek < 1e-4
             print(f"corr_lookup {h8}x{w8}: pallas={ep:.2e} onehot={eo:.2e} "
-                  f"{'OK' if ok else 'FAIL'}", flush=True)
+                  f"packed={ek:.2e} {'OK' if ok else 'FAIL'}", flush=True)
             if not ok:
                 fails.append((h8, w8))
         except Exception as e:

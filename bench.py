@@ -202,6 +202,8 @@ def bench_pipeline(n_copies: int = 8) -> dict:
         sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
     if not sample.exists():
         raise FileNotFoundError("no sample video for the pipeline bench")
+    import contextlib
+    import sys as _sys
     from video_features_tpu.cli import main as cli_main
     with tempfile.TemporaryDirectory(prefix="vft_bench_pipe_") as td:
         vids = []
@@ -210,14 +212,17 @@ def bench_pipeline(n_copies: int = 8) -> dict:
             shutil.copy(sample, dst)
             vids.append(str(dst))
         t0 = time.perf_counter()
-        cli_main([
-            "feature_type=r21d", "precision=bfloat16", "ingest=yuv420",
-            "clip_batch_size=128", "cross_video_batching=true",
-            "video_workers=auto", "allow_random_weights=true",
-            "on_extraction=save_numpy", f"output_path={td}/out",
-            f"tmp_path={td}/tmp",
-            "video_paths=[" + ",".join(vids) + "]",
-        ])
+        # the CLI prints its tally to stdout; bench.py's stdout contract is
+        # ONE JSON line (the driver parses it), so route it to stderr
+        with contextlib.redirect_stdout(_sys.stderr):
+            cli_main([
+                "feature_type=r21d", "precision=bfloat16", "ingest=yuv420",
+                "clip_batch_size=128", "cross_video_batching=true",
+                "video_workers=auto", "allow_random_weights=true",
+                "on_extraction=save_numpy", f"output_path={td}/out",
+                f"tmp_path={td}/tmp",
+                "video_paths=[" + ",".join(vids) + "]",
+            ])
         wall = time.perf_counter() - t0
         clips = sum(np.load(p).shape[0]
                     for p in Path(td, "out").rglob("*_r21d.npy"))

@@ -226,6 +226,12 @@ def bench_pipeline(n_copies: int = 8) -> dict:
         wall = time.perf_counter() - t0
         clips = sum(np.load(p).shape[0]
                     for p in Path(td, "out").rglob("*_r21d.npy"))
+    if clips == 0:
+        # cli_main tallies per-video failures and returns normally; a run
+        # where every video failed must hit the caller's warning path, not
+        # publish 0 clips/s as a measured throughput
+        raise RuntimeError(
+            "pipeline bench produced zero clips — every video failed")
     return {"videos_per_s": n_copies / wall, "clips_per_s": clips / wall,
             "clips": clips, "wall_s": wall}
 

@@ -122,6 +122,95 @@ def test_concurrent_videos_exact_rows(batch, workers):
     assert sum(runner.groups) == sum(counts)
 
 
+class _FailsOnArray:
+    """Stand-in for a device buffer whose D2H read surfaces a runtime
+    error (what a deferred JAX computation failure looks like at
+    np.asarray time)."""
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("device exploded during D2H")
+
+
+class PoisonRunner(FakeRunner):
+    """FakeRunner whose Nth dispatched group fails lazily at
+    materialization — the async-dispatch failure mode."""
+
+    def __init__(self, fail_group: int):
+        super().__init__()
+        self.fail_group = fail_group
+
+    def dispatch(self, group: np.ndarray) -> np.ndarray:
+        gi = len(self.groups)
+        out = super().dispatch(group)
+        return _FailsOnArray() if gi == self.fail_group else out
+
+
+def test_device_failure_poisons_only_group_members():
+    """A group that dies on device must fail exactly its member videos'
+    close_video (with the device error chained) while videos whose clips
+    sit in healthy groups complete normally — no hang, no cross-talk."""
+    runner = PoisonRunner(fail_group=1)
+    p = ClipPacker(runner, batch=2)
+    h1, h2, h3 = p.open_video(), p.open_video(), p.open_video()
+    p.add(h1, _stack(1, 0))
+    p.add(h1, _stack(1, 1))   # group 0 (healthy) dispatches
+    p.add(h2, _stack(2, 0))
+    p.add(h3, _stack(3, 0))   # group 1 (poisoned) dispatches
+    rows = p.close_video(h1)
+    np.testing.assert_array_equal(rows[:, 0], [1000.0, 1001.0])
+    for doomed in (h2, h3):
+        with pytest.raises(RuntimeError, match="failed on device"):
+            p.close_video(doomed)
+
+
+def test_dispatch_failure_propagates_and_poisons_peers():
+    """runner.dispatch raising synchronously must surface at the add()
+    that filled the group AND poison the group's other members so their
+    close_video raises instead of spinning on clips that never ran."""
+
+    class Boom(FakeRunner):
+        def dispatch(self, group):
+            raise RuntimeError("compile blew up")
+
+    p = ClipPacker(Boom(), batch=2)
+    h1, h2 = p.open_video(), p.open_video()
+    p.add(h1, _stack(1, 0))
+    with pytest.raises(RuntimeError, match="compile blew up"):
+        p.add(h2, _stack(2, 0))  # fills the group -> dispatch fails
+    p.abort_video(h2)  # what the adder's extractor except-path does
+    with pytest.raises(RuntimeError, match="failed on device"):
+        p.close_video(h1)
+
+
+def test_stack_mismatch_poisons_members():
+    """np.stack failing inside _dispatch (mismatched clip shapes) has
+    already consumed the clips from the buffer, so it must poison the
+    members like a device failure — not strand their pending counts."""
+    p = ClipPacker(FakeRunner(), batch=2)
+    h1, h2 = p.open_video(), p.open_video()
+    p.add(h1, _stack(1, 0))
+    with pytest.raises(Exception):
+        p.add(h2, np.zeros((2, 3, 3, 3), np.float32))  # wrong shape
+    p.abort_video(h2)
+    with pytest.raises(RuntimeError, match="failed on device"):
+        p.close_video(h1)
+
+
+def test_add_fails_fast_after_poison():
+    """Once a video's group has failed, further add() calls must raise
+    immediately instead of decoding + dispatching doomed clips."""
+    runner = PoisonRunner(fail_group=0)
+    p = ClipPacker(runner, batch=2, depth=1)
+    h1, h2 = p.open_video(), p.open_video()
+    p.add(h1, _stack(1, 0))
+    p.add(h2, _stack(2, 0))   # fills group 0 (poisoned lazily)
+    p.add(h1, _stack(1, 1))
+    p.add(h2, _stack(2, 1))   # fills group 1 -> inflight(2) > depth(1)
+    # forces a drain, materializing poisoned group 0: errors recorded
+    with pytest.raises(RuntimeError, match="failed on device"):
+        p.add(h1, _stack(1, 2))
+
+
 def _write_clip(path: str, frames: int, seed: int) -> str:
     cv2 = pytest.importorskip("cv2")
     w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),

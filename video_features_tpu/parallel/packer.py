@@ -35,6 +35,11 @@ Concurrency design (all state under one lock; D2H copies outside it):
     with a part-filled group.
   - ``depth`` bounds un-materialized device groups, same role as
     FeatureStream's depth.
+  - A group that fails on device (dispatch raises, or the D2H read
+    surfaces a runtime error) poisons exactly its member videos: their
+    pending counts are released and ``close_video`` re-raises for each,
+    so the failure stays per-video (every member is reported failed, the
+    rest of the corpus completes) instead of wedging the whole run.
 """
 from __future__ import annotations
 
@@ -59,6 +64,7 @@ class ClipPacker:
         self._results: Dict[int, Dict[int, np.ndarray]] = {}
         self._counts: Dict[int, int] = {}    # clips added per handle
         self._pending: Dict[int, int] = {}   # clips not yet materialized
+        self._errors: Dict[int, Exception] = {}  # poisoned-group handles
         self._open = 0
         self._closing = 0
         self._next_handle = 0
@@ -79,17 +85,32 @@ class ClipPacker:
         """Append one clip stack; dispatches when the shared group fills."""
         to_dispatch = None
         with self._lock:
+            err = self._errors.get(handle)
+            if err is not None:
+                # an earlier group containing our clips already failed:
+                # stop this video now (the caller's except-path aborts it)
+                # instead of decoding + dispatching clips whose only
+                # possible outcome is a close_video failure
+                raise RuntimeError(
+                    "a packed clip group containing this video's clips "
+                    f"failed on device: {err}") from err
             self._buf.append((handle, self._counts[handle], stack))
             self._counts[handle] += 1
             self._pending[handle] += 1
             if len(self._buf) >= self.batch:
                 to_dispatch, self._buf = self._buf, []
         if to_dispatch is not None:
+            # a dispatch failure contains OUR newest clip: propagate so the
+            # caller's extractor aborts this video now (members poisoned)
             self._dispatch(to_dispatch)
             with self._lock:
                 drain = len(self._inflight) > self.depth
             if drain:
-                self._drain_oldest()
+                try:
+                    self._drain_oldest()
+                except Exception:
+                    pass  # the failed group's members are poisoned; each
+                    # surfaces at its own close_video, not at this add
 
     def abort_video(self, handle: int) -> None:
         """Error-path cleanup (per-video isolation): discard the video's
@@ -103,6 +124,7 @@ class ClipPacker:
             self._results.pop(handle, None)
             self._counts.pop(handle, None)
             self._pending.pop(handle, None)
+            self._errors.pop(handle, None)
             self._open -= 1
             self._cond.notify_all()
 
@@ -133,8 +155,14 @@ class ClipPacker:
                             self._cond.wait(timeout=0.05)
                             continue
                 if to_flush is not None:
-                    self._dispatch(to_flush)
-                self._drain_oldest()
+                    try:
+                        self._dispatch(to_flush)
+                    except Exception:
+                        continue  # members poisoned; ours surfaces below
+                try:
+                    self._drain_oldest()
+                except Exception:
+                    pass  # poisoned members (possibly us) surface below
         finally:
             with self._lock:
                 self._closing -= 1
@@ -142,6 +170,11 @@ class ClipPacker:
                 rows = self._results.pop(handle)
                 n = self._counts.pop(handle)
                 self._pending.pop(handle)
+                err = self._errors.pop(handle, None)
+        if err is not None:
+            raise RuntimeError(
+                "a packed clip group containing this video's clips failed "
+                f"on device: {err}") from err
         if n == 0:
             return np.empty((0,), np.float32)
         return np.stack([rows[i] for i in range(n)])
@@ -154,12 +187,30 @@ class ClipPacker:
         stall every decode thread). The dispatch lock keeps the inflight
         order consistent with dispatch order."""
         with self._dispatch_lock:
-            group = np.stack([s for _, _, s in items])
             manifest = [(h, idx) for h, idx, _ in items]
-            dev = self.runner.dispatch(group)
+            try:
+                # np.stack inside the try: a shape mismatch or MemoryError
+                # here has already consumed the clips from _buf, so it must
+                # poison the members exactly like a device failure
+                group = np.stack([s for _, _, s in items])
+                dev = self.runner.dispatch(group)
+            except Exception as e:
+                self._poison(manifest, e)
+                raise
             with self._lock:
                 self._inflight.append((dev, manifest))
                 self._cond.notify_all()
+
+    def _poison(self, manifest, exc: Exception) -> None:
+        """A group died on device: release its members' pending counts and
+        record the error so each member's ``close_video`` raises instead of
+        spinning forever on clips that will never materialize."""
+        with self._lock:
+            for h, _idx in manifest:
+                if h in self._pending:
+                    self._pending[h] -= 1
+                    self._errors[h] = exc
+            self._cond.notify_all()
 
     def _drain_oldest(self) -> None:
         """Materialize the oldest in-flight group (if any) and route its
@@ -171,7 +222,11 @@ class ClipPacker:
                 if not self._inflight:
                     return
                 dev, manifest = self._inflight.popleft()
-            host = np.asarray(dev)  # blocking D2H
+            try:
+                host = np.asarray(dev)  # blocking D2H
+            except Exception as e:
+                self._poison(manifest, e)
+                raise
             with self._lock:
                 for row, (h, idx) in enumerate(manifest):
                     if h in self._results:

@@ -126,3 +126,34 @@ def test_video_workers_auto(tmp_path):
          "video_paths=/root/reference/sample/v_GGSY1Qvo990.mp4"]))
     sanity_check(args2)
     assert args2.video_workers == "auto"  # resolved at run time in cli.main
+
+
+REF_CONFIGS = "/root/reference/configs"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_CONFIGS),
+                    reason="reference configs not mounted")
+def test_config_defaults_match_reference():
+    """Drop-in compat contract: every key in the reference's per-family
+    config exists here with the SAME default (so a plain
+    `feature_type=<fam>` run means the same thing in both frameworks).
+    Sole exemption: `device` — the reference defaults to 'cuda:0', which
+    this framework accepts and maps to 'auto' (config.py:resolve_device)."""
+    import yaml
+
+    from video_features_tpu.config import build_cfg_path
+
+    for fam in ("resnet", "r21d", "s3d", "i3d", "clip",
+                "vggish", "raft", "pwc"):
+        with open(os.path.join(REF_CONFIGS, f"{fam}.yml")) as f:
+            ref = yaml.safe_load(f)
+        with open(build_cfg_path(fam)) as f:
+            ours = yaml.safe_load(f)
+        for key, want in ref.items():
+            assert key in ours, f"{fam}: reference key {key!r} missing"
+            if key == "device":
+                continue
+            assert ours[key] == want, (
+                f"{fam}.{key}: default {ours[key]!r} diverges from the "
+                f"reference's {want!r} — a drop-in user would silently get "
+                "different behavior")

@@ -179,7 +179,7 @@ def _weight_keys(family: str, args: dict):
         streams = ["rgb", "flow"] if streams in (None, "null") else [streams]
         keys = [f"i3d_{s}" for s in streams]
         if "flow" in streams:
-            flow = args.get("flow_type") or "raft"
+            flow = args.get("flow_type") or "pwc"  # the reference default
             keys.append("raft_sintel" if flow == "raft" else "pwc_sintel")
         return keys
     raise ValueError(family)

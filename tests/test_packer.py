@@ -189,8 +189,8 @@ def test_stack_mismatch_poisons_members():
     p = ClipPacker(FakeRunner(), batch=2)
     h1, h2 = p.open_video(), p.open_video()
     p.add(h1, _stack(1, 0))
-    with pytest.raises(Exception):
-        p.add(h2, np.zeros((2, 3, 3, 3), np.float32))  # wrong shape
+    with pytest.raises(ValueError):  # what np.stack raises for ragged shapes
+        p.add(h2, np.zeros((2, 3, 3, 3), np.float32))
     p.abort_video(h2)
     with pytest.raises(RuntimeError, match="failed on device"):
         p.close_video(h1)

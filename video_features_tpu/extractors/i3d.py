@@ -56,7 +56,7 @@ class ExtractI3D(BaseExtractor):
         for stream in self.streams:
             if stream not in ("rgb", "flow"):
                 raise NotImplementedError(f"Unknown I3D stream: {stream}")
-        self.flow_type = args.get("flow_type", "raft")
+        self.flow_type = args.get("flow_type", "pwc")  # reference default
         self.min_side_size = 256
         self.central_crop_size = 224
         self.extraction_fps = args.get("extraction_fps")

@@ -28,14 +28,17 @@ plain dicts (no omegaconf code runs).
 """
 from __future__ import annotations
 
+import contextlib
 import glob
 import hashlib
 import os
 import pickle
 import shutil
 import types
+import wave
 from contextlib import contextmanager
 from pathlib import Path
+from unittest import mock
 
 import numpy as np
 import pytest
@@ -284,12 +287,44 @@ def _extract_group(family: str, variant: str, sample: str, tmp_root: Path):
     sanity_check(cfg)
 
     value_tier = _value_tier_available(family, ref_args)
+    wav_ctx = contextlib.nullcontext()
+    if family == "vggish" and shutil.which("ffmpeg") is None:
+        # No binary to rip the real audio track. Instead of skipping the
+        # variant, synthesize a wav whose duration is derived from the
+        # RECORDED example count via Google's published VGGish framing
+        # constants (16 kHz, 25 ms/10 ms STFT frames, 96-frame
+        # non-overlapping examples) — NOT from this repo's frontend — and
+        # patch the rip. The real host chain (wav read -> mono mix ->
+        # resample_poly -> log-mel -> framing) still runs and must land on
+        # exactly that count; values can't match synthetic audio, so the
+        # variant is pinned to the shape tier.
+        n = int(_load_ref(GROUPS[key]["vggish"])["data"].shape[0])
+        s16 = 160 * (96 * n + 47) + 400   # mid-window: exactly n examples
+        s44 = int(round(s16 * 44100 / 16000))
+        rng = np.random.default_rng(0)
+        pcm = (rng.uniform(-0.5, 0.5, size=(s44, 2)) * 32767).astype("<i2")
+        synth_dir = tmp_root / family / variant
+        synth_dir.mkdir(parents=True, exist_ok=True)
+        wav = str(synth_dir / "synth_44k.wav")
+        with wave.open(wav, "wb") as w:
+            w.setnchannels(2)
+            w.setsampwidth(2)
+            w.setframerate(44100)
+            w.writeframes(pcm.tobytes())
+        aac = str(synth_dir / "synth.aac")  # rip returns (wav, aac); the
+        Path(aac).touch()                   # extractor removes both
+        wav_ctx = mock.patch(
+            "video_features_tpu.extractors.vggish.extract_wav_from_mp4",
+            lambda vp, tmp: (wav, aac))
+        value_tier = False
+
     extractor = get_extractor_cls(family)(cfg)
-    if value_tier:
-        out = extractor.extract(sample)
-    else:
-        with _stub_forwards():
+    with wav_ctx:
+        if value_tier:
             out = extractor.extract(sample)
+        else:
+            with _stub_forwards():
+                out = extractor.extract(sample)
     _RESULTS[key] = (out, value_tier)
     return _RESULTS[key]
 
@@ -302,9 +337,6 @@ def _extract_group(family: str, variant: str, sample: str, tmp_root: Path):
 def test_golden_variant(group, golden_sample, tmp_path_factory):
     family, variant = group
     refs = {k: _load_ref(p) for k, p in GROUPS[group].items()}
-
-    if family == "vggish" and shutil.which("ffmpeg") is None:
-        pytest.skip("vggish golden needs the ffmpeg binary to rip the wav")
 
     out, value_tier = _extract_group(
         family, variant, golden_sample,

@@ -1,0 +1,106 @@
+"""Dual-API equivalence — the reference's core test mechanism.
+
+The reference computes every feature twice — through the import API
+(``extractor.extract(path)``) and through a literal ``main.py`` subprocess
+— in BOTH save formats, and asserts pairwise closeness (reference
+tests/utils.py:57-120). This file mirrors that mechanism exactly once
+(resnet18 on a tiny synthetic clip): import API vs CLI/save_numpy vs
+CLI/save_pickle must agree on every output key. Random init is
+deterministic (PRNGKey(0) in models/*.init_params), so value equality
+holds across processes without real checkpoints.
+"""
+import os
+import pickle
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def _write_clip(path: str, frames: int = 14) -> str:
+    cv2 = pytest.importorskip("cv2")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"),
+                        16.0, (64, 48))
+    if not w.isOpened():
+        pytest.skip("cv2 cannot encode mp4v")
+    yy, xx = np.mgrid[0:48, 0:64].astype(np.float32)
+    for t in range(frames):
+        frame = np.stack([
+            127 + 120 * np.sin(xx / 9 + t / 5),
+            127 + 120 * np.sin(yy / 7 - t / 6),
+            127 + 120 * np.sin((xx + yy) / 11 + t / 4),
+        ], axis=-1)
+        w.write(frame.clip(0, 255).astype(np.uint8))
+    w.release()
+    return path
+
+
+def _cli(video: str, sink: str, out: Path, tmp: Path, cache: Path,
+         weights: Path) -> None:
+    ext = ".npy" if sink == "save_numpy" else ".pkl"
+    cmd = [sys.executable, "main.py", "feature_type=resnet",
+           "model_name=resnet18", "device=cpu", "batch_size=4",
+           "extraction_fps=4", "allow_random_weights=true",
+           f"on_extraction={sink}", f"output_path={out}", f"tmp_path={tmp}",
+           f"compilation_cache_dir={cache}", f"video_paths={video}"]
+    res = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                         timeout=600,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu",
+                              "VFT_WEIGHTS_DIR": str(weights),
+                              "TORCH_HOME": str(weights / "torch_home")})
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    # the CLI isolates per-video errors (tally + exit 0), so rc alone can't
+    # prove the extraction ran: require the feature file, with the captured
+    # output in the failure message
+    feat = out / "resnet" / "resnet18" / f"v_resnet{ext}"
+    assert feat.exists(), (
+        f"{sink}: no {feat} —\n" + res.stdout[-2000:] + res.stderr[-2000:])
+
+
+def test_import_api_and_both_cli_sinks_agree(tmp_path, monkeypatch):
+    # isolate weight resolution: no real checkpoints/caches, no writes to
+    # the user's cache — all three runs must take the seeded random init
+    weights = tmp_path / "weights"
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(weights))
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    video = _write_clip(str(tmp_path / "v.mp4"))
+    cache = tmp_path / "xla_cache"  # shared: compile once across all runs
+
+    # import API
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+    cfg = load_config("resnet", {
+        "model_name": "resnet18", "device": "cpu", "batch_size": 4,
+        "extraction_fps": 4, "allow_random_weights": True,
+        "on_extraction": "save_numpy",
+        "output_path": str(tmp_path / "api_out"),
+        "tmp_path": str(tmp_path / "api_tmp"),
+        "video_paths": video,
+    })
+    sanity_check(cfg)
+    api = get_extractor_cls("resnet")(cfg).extract(video)
+
+    # CLI subprocesses, one per save format
+    _cli(video, "save_numpy", tmp_path / "np_out", tmp_path / "np_tmp",
+         cache, weights)
+    _cli(video, "save_pickle", tmp_path / "pk_out", tmp_path / "pk_tmp",
+         cache, weights)
+    np_dir = tmp_path / "np_out" / "resnet" / "resnet18"
+    pk_dir = tmp_path / "pk_out" / "resnet" / "resnet18"
+
+    for key in ("resnet", "fps", "timestamps_ms"):
+        assert key in api, f"import API output missing {key!r}"
+        from_npy = np.load(np_dir / f"v_{key}.npy")
+        with open(pk_dir / f"v_{key}.pkl", "rb") as f:
+            from_pkl = np.asarray(pickle.load(f))
+        # same seed, same math, different processes/sinks: pairwise close
+        np.testing.assert_allclose(np.asarray(api[key]), from_npy,
+                                   atol=1e-6, rtol=1e-6, err_msg=f"{key}: "
+                                   "import API vs CLI save_numpy")
+        np.testing.assert_allclose(from_npy, from_pkl, atol=0, rtol=0,
+                                   err_msg=f"{key}: save_numpy vs "
+                                   "save_pickle")

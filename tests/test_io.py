@@ -216,3 +216,17 @@ def test_device_resize_mixed_resolutions(sample_video, tmp_path, monkeypatch):
     cos = np.sum(sm * sm_host, axis=1) / (
         np.linalg.norm(sm, axis=1) * np.linalg.norm(sm_host, axis=1) + 1e-9)
     assert np.all(cos > 0.999), cos.min()
+
+
+def test_channel_order_bgr_is_flipped_rgb(sample_video):
+    """channel_order='bgr' must yield exactly the decoder frames the default
+    mode yields, minus the cvtColor — i.e. the same bytes channel-reversed.
+    (The deferred-reorder transforms in r21d/s3d/frame-wise device-resize
+    rely on this identity.)"""
+    rgb_src = VideoSource(sample_video, batch_size=3)
+    bgr_src = VideoSource(sample_video, batch_size=3, channel_order="bgr")
+    (rgb, _, _) = next(iter(rgb_src))
+    (bgr, _, _) = next(iter(bgr_src))
+    assert len(rgb) == len(bgr) == 3
+    for r, b in zip(rgb, bgr):
+        np.testing.assert_array_equal(r, b[:, :, ::-1])

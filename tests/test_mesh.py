@@ -60,8 +60,10 @@ def test_data_parallel_over_eight_virtual_devices():
     out = runner(x)
     assert out.shape == (5, 3)
     np.testing.assert_allclose(out, x * 2.0 + 1.0)
-    # fixed_batch: smaller batches must pad up to the fixed shape so only
-    # one executable is compiled per video; the traced shape proves it
+    # fixed_batch caps a power-of-two bucket ladder: ragged host batches
+    # trace at the smallest mesh-divisible bucket that holds them (wire
+    # bytes bounded at 2x the rows), full batches at fixed_batch itself;
+    # the executable count stays logarithmic. The traced shapes prove it.
     traced_shapes = []
 
     def fn(p, b):
@@ -70,9 +72,20 @@ def test_data_parallel_over_eight_virtual_devices():
 
     runner2 = DataParallelApply(fn, {"scale": np.float32(3.0)}, mesh=mesh,
                                 fixed_batch=16)
-    np.testing.assert_allclose(runner2(x), x * 3.0)
-    assert traced_shapes == [(16, 3)], traced_shapes
+    np.testing.assert_allclose(runner2(x), x * 3.0)        # 5 -> bucket 8
+    full = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    np.testing.assert_allclose(runner2(full), full * 3.0)  # 16 -> 16
+    mid = np.arange(9 * 3, dtype=np.float32).reshape(9, 3)
+    np.testing.assert_allclose(runner2(mid), mid * 3.0)    # 9 -> 16 (cached)
+    assert traced_shapes == [(8, 3), (16, 3)], traced_shapes
     assert runner2.padded_batch_size(5) == 8
+    assert runner2.bucket_batch_size(5) == 8
+    assert runner2.bucket_batch_size(9) == 16
+    assert runner2.bucket_batch_size(16) == 16
+    assert runner2.bucket_batch_size(2) == 8  # mesh floor: 8 devices
+    assert runner2.bucket_batch_size(20) == 24  # > fixed_batch: pad up
+    big = np.arange(20 * 3, dtype=np.float32).reshape(20, 3)
+    np.testing.assert_allclose(runner2(big), big * 3.0)
 
 
 def test_feature_stream_matches_sync_path():

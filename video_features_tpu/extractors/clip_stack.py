@@ -41,6 +41,13 @@ class ClipStackExtractor(BaseExtractor):
     #: maximum-throughput mode bench.py measures).
     supported_ingest = ("uint8", "float32")
 
+    #: families whose host transform is entirely channel-independent
+    #: (float conversion, resize, crop) set 'bgr' and reorder channels on
+    #: their smallest intermediate instead — this skips a full-resolution
+    #: cv2.cvtColor per decoded frame, bit-identically (utils/io.py
+    #: _FrameStream)
+    frame_channel_order = "rgb"
+
     def __init__(self, args: Config, default_stack: int, default_step: int) -> None:
         super().__init__(args)
         self.model_name = args.get("model_name")
@@ -90,7 +97,8 @@ class ClipStackExtractor(BaseExtractor):
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
-                          transform=self.host_transform)
+                          transform=self.host_transform,
+                          channel_order=self.frame_channel_order)
         if self.cross_video:
             return self._extract_packed(src)
         return self._extract_grouped(src)

@@ -93,7 +93,11 @@ class FrameWiseExtractor(BaseExtractor):
             base = self.base_fwd
 
             def fwd(params, raw_u8):
-                x = resize(raw_u8)
+                # frames arrive decoder-native BGR (channel_order below):
+                # the RGB reorder is a reversed gather XLA fuses into the
+                # resize matmul's input read — the host never runs a
+                # full-resolution cvtColor in this mode
+                x = resize(raw_u8[..., ::-1])
                 return base(params, x[:, i:i + c, j:j + c, :])
 
             return self.runner_builder(fwd)
@@ -107,8 +111,10 @@ class FrameWiseExtractor(BaseExtractor):
             batch_size=self.batch_size,
             fps=self.extraction_fps,
             total=self.extraction_total,
-            # device_resize: host ships raw decoded frames
+            # device_resize: host ships raw decoded frames, in decoder-
+            # native BGR — the reorder rides the device resize for free
             transform=None if device_resize else self.host_transform,
+            channel_order="bgr" if device_resize else "rgb",
         )
         vid_feats: List[np.ndarray] = []
         timestamps_ms: List[float] = []

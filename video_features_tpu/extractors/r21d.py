@@ -51,6 +51,7 @@ def _device_forward_yuv420(model: r21d_model.R2Plus1D, dtype, params, batch):
 class ExtractR21D(ClipStackExtractor):
 
     supported_ingest = ("yuv420", "uint8", "float32")
+    frame_channel_order = "bgr"  # RGB reorder deferred into the transform
 
     def __init__(self, args: Config) -> None:
         if args.model_name not in r21d_model.VARIANTS:
@@ -79,10 +80,15 @@ class ExtractR21D(ClipStackExtractor):
             cast_floating(params["backbone"], dtype),
             mesh=mesh, fixed_batch=self.clip_batch_size)
 
-        def transform(rgb: np.ndarray) -> np.ndarray:
-            x = rgb.astype(np.float32) / 255.0
+        def transform(bgr: np.ndarray) -> np.ndarray:
+            # frames arrive in decoder-native BGR (frame_channel_order);
+            # float/resize/crop are channel-independent, so the RGB reorder
+            # happens on the 112px crop — 6x fewer pixels than a
+            # full-resolution cvtColor, bit-identical result
+            x = bgr.astype(np.float32) / 255.0
             x = pp.bilinear_resize_no_antialias(x, (128, 171))
-            return self.encode_wire(pp.center_crop(x, 112))
+            x = np.ascontiguousarray(pp.center_crop(x, 112)[:, :, ::-1])
+            return self.encode_wire(x)
 
         self.host_transform = transform
 

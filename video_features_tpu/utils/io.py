@@ -113,11 +113,22 @@ def fps_filter_map(num_frames: int, src_fps: float, dst_fps: float) -> np.ndarra
 
 
 class _FrameStream:
-    """Sequential decoder with the missing-frame-0 workaround."""
+    """Sequential decoder with the missing-frame-0 workaround.
 
-    def __init__(self, path: str):
+    ``channel_order='bgr'`` skips the per-frame ``cv2.cvtColor`` and yields
+    the decoder's native BGR buffer. Transforms whose ops are all
+    channel-independent (float conversion, resize, crop) can defer the
+    RGB reorder to their smallest intermediate — a cheap slice on a
+    112px crop instead of a full-resolution conversion pass per frame —
+    with bit-identical results (channel reorder commutes with per-channel
+    ops). The r21d/s3d host transforms use this.
+    """
+
+    def __init__(self, path: str, channel_order: str = "rgb"):
+        assert channel_order in ("rgb", "bgr"), channel_order
         self.cap = cv2.VideoCapture(path)
         self._first = True
+        self._native = channel_order == "bgr"
 
     def read(self) -> Optional[np.ndarray]:
         ok, frame = self.cap.read()
@@ -128,6 +139,8 @@ class _FrameStream:
         self._first = False
         if not ok:
             return None
+        if self._native:
+            return frame
         return cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
 
     def release(self):
@@ -149,7 +162,8 @@ class VideoSource:
                  fps: Optional[float] = None,
                  total: Optional[int] = None,
                  transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
-                 overlap: int = 0):
+                 overlap: int = 0,
+                 channel_order: str = "rgb"):
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
         if fps is not None and total is not None:
@@ -158,6 +172,8 @@ class VideoSource:
         self.batch_size = batch_size
         self.transform = transform
         self.overlap = overlap
+        #: 'bgr' defers the RGB reorder into the transform (see _FrameStream)
+        self.channel_order = channel_order
 
         props = get_video_props(self.path)
         self.src_fps = props["fps"]
@@ -196,7 +212,7 @@ class VideoSource:
         resize/crop would silently be skipped for one of them.
         """
         from .profiling import profiler
-        stream = _FrameStream(self.path)
+        stream = _FrameStream(self.path, self.channel_order)
         tf = self.transform
 
         def emit(rgb, out_idx):

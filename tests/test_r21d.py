@@ -104,7 +104,8 @@ def test_streaming_path_matches_buffered(sample_video, tmp_path):
     def make_src():
         return VideoSource(sample_video, batch_size=1,
                            fps=ex.extraction_fps,
-                           transform=ex.host_transform)
+                           transform=ex.host_transform,
+                           channel_order=ex.frame_channel_order)
 
     # the streaming window former (disjoint regime, frames dropped as
     # decoded) must produce exactly the windows form_slices prescribes over

@@ -203,3 +203,39 @@ def test_i3d_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
         cos = np.sum(a * b, axis=1) / (np.linalg.norm(a, axis=1)
                                        * np.linalg.norm(b, axis=1) + 1e-9)
         assert np.all(cos > 0.99), (stream, cos.min())
+
+
+def test_device_flow_multi_stack_chunking(rng):
+    """_device_flow fuses k stacks' pair batches into one flow forward
+    (round-4 throughput lever); the chunk/reshape/slice algebra must hand
+    each stack exactly its own pairs, padded runner rows dropped."""
+    from video_features_tpu.extractors.i3d_flow import FlowStream
+
+    class FakeRunner:
+        def dispatch(self, pairs):
+            # per-pair signature + 3 fake padded rows (dispatch() keeps
+            # padding, the caller must slice it off)
+            x = jnp.asarray(pairs, jnp.float32)
+            return jnp.pad(x.mean(axis=(1, 2, 3, 4)), (0, 3))
+
+    fs = FlowStream.__new__(FlowStream)
+    fs.pair_runner = FakeRunner()
+    group = rng.integers(0, 255, size=(3, 5, 16, 16, 3)).astype(np.uint8)
+    fs.stack_batch = 2  # chunks of 2 + ragged 1
+    got = np.asarray(fs._device_flow(group))
+    fs.stack_batch = 1  # the round-3 per-stack path
+    want = np.asarray(fs._device_flow(group))
+    assert got.shape == want.shape == (3, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    pairs0 = np.stack([group[0, :-1], group[0, 1:]], axis=1)
+    np.testing.assert_allclose(
+        got[0], pairs0.reshape(4, -1).mean(axis=1), rtol=1e-5)
+
+
+def test_stacks_per_forward_geometry_budget():
+    """Auto flow-stack batching: 4 at the 224px flagship geometry, scaled
+    down for larger sources so the correlation pyramid fits HBM."""
+    from video_features_tpu.extractors.i3d_flow import _stacks_per_forward
+    assert _stacks_per_forward(64, 224, 224) == 4
+    assert _stacks_per_forward(64, 256, 454) == 1  # 3.8 GB/stack pyramid
+    assert _stacks_per_forward(16, 64, 64) == 4    # tiny input: cap wins

@@ -101,6 +101,70 @@ def test_corr_lookup_packed_degenerate_pyramid(rng):
     np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
 
 
+def _proj_weight(rng, c_out=24):
+    w = rng.normal(size=(4 * 81, c_out)).astype(np.float32) * 0.1
+    b = rng.normal(size=(c_out,)).astype(np.float32)
+    return jnp.asarray(w), jnp.asarray(b)
+
+
+def test_corr_lookup_proj_matches_composition(rng):
+    """The fused lookup+convc1 kernel (round-4 TPU default inside the RAFT
+    scan) equals the unfused composition relu(lookup @ W + b)."""
+    from video_features_tpu.kernels.corr_lookup import (
+        corr_lookup_proj, corr_lookup_proj_ref, proj_lookup_supported,
+        stack_aligned_pyramid)
+    pyramid, coords, _ = _pyramid_and_coords(rng)
+    assert proj_lookup_supported(pyramid)
+    wgt, bias = _proj_weight(rng)
+    stacked, metas = stack_aligned_pyramid(pyramid)
+    ref = np.asarray(corr_lookup_proj_ref(pyramid, coords, wgt, bias))
+    ours = np.asarray(corr_lookup_proj(stacked, metas, coords, wgt, bias,
+                                       interpret=True))
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_corr_lookup_proj_integer_and_oob_coords(rng):
+    """fx=fy=0 degenerate bilinear weights (the hat selector's exact-1 peak)
+    and fully out-of-range windows (zeros rule -> relu(bias))."""
+    from video_features_tpu.kernels.corr_lookup import (
+        corr_lookup_proj, corr_lookup_proj_ref, stack_aligned_pyramid)
+    pyramid, _, (h8, w8) = _pyramid_and_coords(rng)
+    b = pyramid[0].shape[0]
+    gx, gy = np.meshgrid(np.arange(w8, dtype=np.float32),
+                         np.arange(h8, dtype=np.float32))
+    coords = np.broadcast_to(np.stack([gx, gy], -1),
+                             (b, h8, w8, 2)).copy()
+    coords[:, 0, :, :] = -50.0  # first row: windows fully out of range
+    coords = jnp.asarray(coords)
+    wgt, bias = _proj_weight(rng)
+    stacked, metas = stack_aligned_pyramid(pyramid)
+    ref = np.asarray(corr_lookup_proj_ref(pyramid, coords, wgt, bias))
+    ours = np.asarray(corr_lookup_proj(stacked, metas, coords, wgt, bias,
+                                       interpret=True))
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+    want_oob = np.broadcast_to(np.maximum(np.asarray(bias), 0.0),
+                               ours[:, 0].shape)
+    np.testing.assert_allclose(ours[:, 0], want_oob, atol=1e-6)
+
+
+def test_corr_lookup_proj_degenerate_pyramid(rng):
+    """Tiny inputs pool down to 1x1 and 0x0 levels; the fused kernel skips
+    the empty level (its taps are all in the zeros-padding region)."""
+    from video_features_tpu.kernels.corr_lookup import (
+        corr_lookup_proj, corr_lookup_proj_ref, stack_aligned_pyramid)
+    pyramid, coords, _ = _pyramid_and_coords(rng, h8=6, w8=5, c=16)
+    shapes = [tuple(c.shape[2:]) for c in pyramid]
+    assert (1, 1) in shapes and (0, 0) in shapes, shapes
+    wgt, bias = _proj_weight(rng)
+    stacked, metas = stack_aligned_pyramid(pyramid)
+    assert metas[-1].hlp == 0
+    ref = np.asarray(corr_lookup_proj_ref(pyramid, coords, wgt, bias))
+    ours = np.asarray(corr_lookup_proj(stacked, metas, coords, wgt, bias,
+                                       interpret=True))
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
 def test_pack_pyramid_geometry(rng):
     """The lane-dense packing stays dense: one 128-lane line carries
     multiple narrow image rows, all levels' row-groups share ONE fused

@@ -177,6 +177,32 @@ def test_iters_config_knob(tmp_path):
     assert build(iters=2).model.iters == 2
 
 
+def test_fused_convc1_path_matches_default(rng, monkeypatch):
+    """The fused lookup+convc1 scan path (VFT_CORR_LOOKUP=pallas, the TPU
+    default — interpret mode here) produces the same flow as the gather
+    path, through the full model: same param tree (the _Convc1Params twin
+    shares nn.Conv's path/shapes), same numerics up to matmul reorder."""
+    from video_features_tpu.models import raft as rm
+
+    params = rm.init_params(iters=4)
+    assert params["update_block"]["encoder"]["convc1"]["kernel"].shape \
+        == (1, 1, 324, 256)
+    x1 = jnp.asarray(rng.integers(
+        0, 255, size=(1, 64, 72, 3)).astype(np.float32))
+    x2 = jnp.asarray(rng.integers(
+        0, 255, size=(1, 64, 72, 3)).astype(np.float32))
+    model = rm.RAFT(iters=4)
+    want = np.asarray(model.apply({"params": params}, x1, x2))
+    monkeypatch.setenv("VFT_CORR_LOOKUP", "pallas")
+    monkeypatch.setenv("VFT_FUSE_CONVC1", "1")
+    got = np.asarray(model.apply({"params": params}, x1, x2))
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+    # and the explicitly-unfused pallas path still matches too
+    monkeypatch.setenv("VFT_FUSE_CONVC1", "0")
+    unfused = np.asarray(model.apply({"params": params}, x1, x2))
+    np.testing.assert_allclose(unfused, want, atol=1e-3, rtol=1e-3)
+
+
 def test_bfloat16_mode_close_to_f32(rng):
     """RAFT(dtype=bf16) + bf16 params: convs run MXU-native while pyramid/
     coords/norms stay f32 (models/raft.py RAFT docstring). Flow drift must

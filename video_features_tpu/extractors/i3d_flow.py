@@ -60,10 +60,44 @@ def _pwc_quantized_flow(model, crop: int, params, pairs_u8):
     return _crop_quantize(flow, crop)
 
 
+#: HBM budget for one pair-batch forward's correlation pyramid — the
+#: dominant RAFT allocation, (pairs, P, Hsum, Wp) f32 (kernels/corr_lookup
+#: stack_aligned_pyramid). 7 GB picks 4 stacks/forward at the 224px
+#: flagship geometry (6.6 GB, measured fine on 16 GB v5e incl. towers) and
+#: scales down automatically for larger source resolutions.
+_FLOW_PYRAMID_BUDGET = 7 * 1024 ** 3
+
+
+def _stacks_per_forward(t: int, h: int, w: int, cap: int = 4) -> int:
+    """How many stacks' pair batches to fuse into one flow forward.
+
+    Round-4 measurement (scripts/bench_i3d_variants.py, interleaved): 1 ->
+    2 -> 4 stacks per RAFT forward measured 3.94 -> 4.41 -> 4.50 stacks/s
+    unfused and 5.90 -> 6.34 fused at 64f@224px on v5e — more queries per
+    launch amortize per-dispatch and per-scan-iteration fixed costs.
+    Power-of-two result (wire buckets pad power-of-two), capped by the
+    pyramid HBM budget at this geometry."""
+    from ..kernels.corr_lookup import stacked_plane_cells
+    h8, w8 = -(-h // 8), -(-w // 8)  # RAFT pads inputs to /8 (InputPadder)
+    per_stack = t * (h8 * w8) * 4 * stacked_plane_cells(
+        h8, w8, levels=raft_model.CORR_LEVELS)
+    k = 1
+    while k * 2 <= cap and (k * 2) * per_stack <= _FLOW_PYRAMID_BUDGET:
+        k *= 2
+    return k
+
+
 class FlowStream:
 
     def __init__(self, parent, args, mesh, dtype, allow_random) -> None:
         self.parent = parent
+        # stacks fused per flow forward: 'auto' (geometry-sized at dispatch,
+        # see _stacks_per_forward) or a forced integer
+        raw_sb = args.get("flow_stack_batch", "auto")
+        self.stack_batch = None if raw_sb in (None, "auto") else int(raw_sb)
+        if self.stack_batch is not None and self.stack_batch < 1:
+            raise ValueError(
+                f"flow_stack_batch={self.stack_batch}: need >= 1 or 'auto'")
         crop = parent.central_crop_size
         if parent.flow_type == "raft":
             # the reference hardcodes the sintel checkpoint for the i3d flow
@@ -155,12 +189,32 @@ class FlowStream:
 
     def _device_flow(self, group):
         t = group.shape[1] - 1  # T pairs from T+1 frames
-        # dispatch() keeps padded rows (stack_size may not divide the mesh),
-        # so slice back to the T valid pairs — a lazy on-device slice.
-        # np/jnp stack both work: raw host groups arrive as np, resized
-        # device groups as jax arrays (rows sliced lazily)
+        # np/jnp both work: raw host groups arrive as np, resized device
+        # groups as jax arrays (rows sliced lazily). Multiple stacks' pair
+        # batches fuse into ONE flow forward (k*T pairs): more queries per
+        # launch amortize per-dispatch and per-scan-iteration fixed costs
+        # (+45% stacks/s at 64f@224px going 1 -> 4, round-4 interleaved
+        # A/B); k is geometry-budgeted so the correlation pyramid of a
+        # large source cannot blow HBM (_stacks_per_forward).
         xp = jnp if not isinstance(group, np.ndarray) else np
-        quant = [self.pair_runner.dispatch(xp.stack([g[:-1], g[1:]],
-                                                    axis=1))[:t]
-                 for g in group]
-        return jnp.stack(quant)
+        if self.stack_batch is not None:
+            k = self.stack_batch
+        elif self.parent.flow_type == "raft":
+            k = _stacks_per_forward(t, *group.shape[2:4])
+        else:
+            # auto applies only where the HBM model is validated: the
+            # budget models RAFT's all-pairs pyramid, which PWC does not
+            # allocate. PWC keeps per-stack dispatch unless the user
+            # forces flow_stack_batch explicitly.
+            k = 1
+        outs = []
+        for i in range(0, len(group), k):
+            chunk = group[i:i + k]            # (kc, T+1, H, W, 3)
+            kc = chunk.shape[0]
+            pairs = xp.stack([chunk[:, :-1], chunk[:, 1:]], axis=2)
+            pairs = pairs.reshape((kc * t,) + pairs.shape[2:])
+            # dispatch() keeps padded rows (the wire bucket may exceed
+            # kc*t), so slice back to the valid pairs — a lazy device slice
+            q = self.pair_runner.dispatch(pairs)[:kc * t]
+            outs.append(q.reshape((kc, t) + q.shape[1:]))
+        return jnp.concatenate(outs) if len(outs) > 1 else outs[0]

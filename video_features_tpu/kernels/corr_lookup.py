@@ -295,6 +295,237 @@ def corr_lookup_pallas(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
     return jnp.concatenate(out, axis=-1).reshape(b, h, w, -1)
 
 
+# ---- fused lookup + convc1 projection (round-4 TPU default) --------------
+#
+# Round-4 profiling (scripts/bench_i3d_variants.py --trace): the four
+# per-level lookup kernels cost ~100 ms of a 215 ms I3D RGB+Flow step and
+# ALL levels cost the same ~25 ms despite 4-64x different plane sizes —
+# the binding cost is per-query work on the 128-lane-padded width
+# (selector build + blend + 9-lane-wide stores), which is level-size
+# independent. Downstream, the (B, H, W, 324) lookup output is a relayout
+# boundary XLA cannot see through (~17 ms/step of reshape passes feeding
+# the motion encoder's convc1, models/raft.py:177-180).
+#
+# This kernel removes both ends at once:
+#   - the bilinear blend folds INTO the selectors (9 weighted rows instead
+#     of 10 one-hot rows + a 4-corner blend), and
+#   - the motion encoder's convc1 (a 1x1 conv, i.e. a (324, 256) matmul)
+#     folds INTO the kernel as per-level (81, 256) projections of the tap
+#     window, accumulated across levels in VMEM — so the kernel emits the
+#     post-conv (TP, 256) activation (dense, tile-aligned stores) and the
+#     324-channel intermediate never exists.
+#
+# All four levels ride ONE kernel over a sublane-stacked pyramid plane
+# (one contiguous block DMA per grid step; level planes are static sublane
+# slices). The projection weight is a constant-index block, so Mosaic
+# keeps it resident across grid steps.
+
+
+class ProjMeta(NamedTuple):
+    """Static geometry of one level inside the sublane-stacked plane."""
+    hlp: int  # lane-padded sublane rows of this level
+    off: int  # sublane offset of this level in the stacked plane
+
+
+def stack_aligned_pyramid(pyramid: Sequence[jnp.ndarray]
+                          ) -> Tuple[jnp.ndarray, Tuple[ProjMeta, ...]]:
+    """Align every (B, P, Hl, Wl) level (zero pad: Hl -> 8-multiple, Wl ->
+    128-multiple — the zeros ARE the reference's out-of-range rule, see
+    :func:`align_level`), pad all levels to the widest lane width, and
+    concatenate along sublanes into ONE (B, P, Hsum, Wp) plane. Hoist this
+    OUT of the GRU scan (loop-invariant)."""
+    aligned = [align_level(c) for c in pyramid]
+    wp = max(c.shape[3] for c in aligned)
+    aligned = [c if c.shape[3] == wp else
+               jnp.pad(c, ((0, 0), (0, 0), (0, 0), (0, wp - c.shape[3])))
+               for c in aligned]
+    metas = []
+    off = 0
+    for c in aligned:
+        metas.append(ProjMeta(c.shape[2], off))
+        off += c.shape[2]
+    return jnp.concatenate(aligned, axis=2), tuple(metas)
+
+
+def stacked_plane_cells(h8: int, w8: int, levels: int = 4) -> int:
+    """Per-query cell count (Hsum * Wp) of the plane
+    :func:`stack_aligned_pyramid` builds for a /8 feature grid of
+    (h8, w8) — each level 8-sublane/128-lane aligned, floor-halved with
+    the odd-drop rule (build_corr_pyramid's torch avg_pool semantics).
+    Shared by the VMEM support gate here and the flow-stream HBM budget
+    (extractors/i3d_flow.py _stacks_per_forward) so the geometry math has
+    exactly one owner."""
+    hsum, wp = 0, 128
+    for _ in range(levels):
+        hsum += -(-h8 // 8) * 8
+        wp = max(wp, -(-w8 // 128) * 128)
+        h8, w8 = h8 // 2, w8 // 2
+    return hsum * wp
+
+
+def proj_lookup_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
+    """Whether the fused projection kernel can tile these planes: one
+    stacked-plane block at the 8-query tile floor must fit the probed VMEM
+    budget (same envelope as the per-level kernel)."""
+    h0, w0 = pyramid[0].shape[2], pyramid[0].shape[3]
+    cells = stacked_plane_cells(h0, w0, levels=len(pyramid))
+    return 8 * cells * 4 <= _VMEM_BLOCK_BYTES
+
+
+def _proj_kernel(cx_ref, cy_ref, corr_ref, w_ref, b_ref, out_ref, taps_ref,
+                 *, radius: int, metas: Tuple[ProjMeta, ...]):
+    """One grid step: TP queries x ALL levels -> relu(lookup @ W + b).
+
+    Block shapes: cx/cy (1, TP, 1, 1) pre-expanded on the host; corr
+    (1, TP, Hsum, Wp) — the stacked plane; w (L*n*n, C) with row order
+    matching the lookup channel order (per level, tap k = xx*n + yy,
+    x-offset slowest — the reference's quirk); b (1, C); out (1, TP, C);
+    taps_ref a (TP, L*n*n) VMEM scratch. The blended windows land in
+    scratch via lane-sliced stores (never HBM), then ONE rank-2
+    (TP, L*n*n) @ (L*n*n, C) matmul projects them — Mosaic's tpu.matmul
+    takes exactly one contracting dim and position-matched batch dims
+    only, so the multi-dim-contraction and batched forms of this
+    projection are unavailable (both probed on hardware)."""
+    n = 2 * radius + 1
+    tp, hsum, wp = corr_ref.shape[1:]
+    cx = cx_ref[0]  # (TP, 1, 1)
+    cy = cy_ref[0]
+    corr_all = corr_ref[0].astype(jnp.float32)  # (TP, Hsum, Wp)
+    d9 = jax.lax.broadcasted_iota(
+        jnp.int32, (1, n, 1), 1).astype(jnp.float32)
+    for lvl, m in enumerate(metas):
+        if m.hlp == 0:
+            # degenerate level (tiny inputs pool to 0x0): every tap reads
+            # the zeros-padding region and contributes nothing to the
+            # projection; zero the scratch lanes it owns
+            base = lvl * n * n
+            taps_ref[:, base:base + n * n] = jnp.zeros((tp, n * n),
+                                                       jnp.float32)
+            continue
+        px0 = cx * (1.0 / (1 << lvl)) - radius
+        py0 = cy * (1.0 / (1 << lvl)) - radius
+        # bilinear selectors DIRECTLY as triangular hats: the weight of
+        # plane column w for tap xx is relu(1 - |w - (px0 + xx)|) — exactly
+        # (1-fx) at the left corner, fx at the right, 0 elsewhere, and 0
+        # for every out-of-plane tap (no lane in range), which is the
+        # reference's zeros-padding rule. Half the VPU work of building
+        # (n+1)-row corner one-hots and blending 4 corners.
+        yl = jax.lax.broadcasted_iota(
+            jnp.int32, (tp, n, m.hlp), 2).astype(jnp.float32)
+        xl = jax.lax.broadcasted_iota(
+            jnp.int32, (tp, n, wp), 2).astype(jnp.float32)
+        yw = jnp.maximum(1.0 - jnp.abs(yl - py0 - d9), 0.0)  # (TP, 9, Hlp)
+        xw = jnp.maximum(1.0 - jnp.abs(xl - px0 - d9), 0.0)  # (TP, 9, Wp)
+        level = jax.lax.slice_in_dim(corr_all, m.off, m.off + m.hlp, axis=1)
+        u = jax.lax.dot_general(       # (TP, 9x, Hlp)
+            xw, level, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        taps = jax.lax.dot_general(    # (TP, 9x, 9y) — blended tap window
+            u, yw, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        base = lvl * n * n
+        for i in range(n):  # lane-sliced stores into VMEM scratch
+            taps_ref[:, base + i * n:base + (i + 1) * n] = taps[:, i, :]
+    acc = jax.lax.dot_general(  # ONE rank-2 projection matmul off scratch
+        taps_ref[...], w_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out_ref[0] = jnp.maximum(acc + b_ref[...], 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("metas", "radius", "interpret",
+                                             "tile_p"))
+def _corr_lookup_proj_flat(stacked: jnp.ndarray,
+                           metas: Tuple[ProjMeta, ...],
+                           cx: jnp.ndarray, cy: jnp.ndarray,
+                           weight: jnp.ndarray, bias: jnp.ndarray,
+                           radius: int = 4, interpret: bool = False,
+                           tile_p: Optional[int] = None) -> jnp.ndarray:
+    """Flat-query fused lookup+projection: stacked (1, Q, Hsum, Wp) plane,
+    cx/cy (1, Q) level-0 centers, weight (L*(2r+1)^2, C), bias (C,).
+    Returns (1, Q, C) = relu(lookup @ weight + bias)."""
+    _, q, hsum, wp = stacked.shape
+    n = 2 * radius + 1
+    c_out = weight.shape[1]
+    plane = hsum * wp * 4
+    if 8 * plane > _VMEM_BLOCK_BYTES:
+        raise ValueError(
+            f"stacked corr plane ({hsum}x{wp}) too large for any legal "
+            "VMEM tile; use the unfused path (proj_lookup_supported "
+            "gates this dispatch)")
+    if tile_p is None:
+        tile_p = min(_MAX_TILE_P, max(8, _VMEM_BLOCK_BYTES // plane))
+    tp = _best_tile(q, tile_p)
+    qq = -(-q // tp) * tp
+    if qq != q:
+        stacked = jnp.pad(stacked, ((0, 0), (0, qq - q), (0, 0), (0, 0)))
+        cx = jnp.pad(cx, ((0, 0), (0, qq - q)))
+        cy = jnp.pad(cy, ((0, 0), (0, qq - q)))
+    coord_spec = pl.BlockSpec((1, tp, 1, 1), lambda qi: (0, qi, 0, 0),
+                              memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_proj_kernel, radius=radius, metas=metas),
+        grid=(qq // tp,),
+        in_specs=[
+            coord_spec, coord_spec,
+            pl.BlockSpec((1, tp, hsum, wp), lambda qi: (0, qi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            # constant index maps: Mosaic keeps these blocks resident
+            # across grid steps (no per-program re-DMA)
+            pl.BlockSpec((len(metas) * n * n, c_out), lambda qi: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c_out), lambda qi: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tp, c_out), lambda qi: (0, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, qq, c_out), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tp, len(metas) * n * n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(cx.astype(jnp.float32)[..., None, None],
+      cy.astype(jnp.float32)[..., None, None], stacked,
+      weight, bias.reshape(1, c_out))
+    return out[:, :q]
+
+
+def corr_lookup_proj(stacked: jnp.ndarray, metas: Tuple[ProjMeta, ...],
+                     coords: jnp.ndarray, weight: jnp.ndarray,
+                     bias: jnp.ndarray, radius: int = 4,
+                     interpret: bool = False) -> jnp.ndarray:
+    """Fused windowed lookup + convc1 projection + bias + relu over a
+    pre-stacked pyramid (see :func:`stack_aligned_pyramid`).
+
+    coords: (B, H, W, 2) level-0 (x, y); weight (L*(2r+1)^2, C) rows in
+    the lookup's channel order; bias (C,). Returns (B, H, W, C) float32 =
+    ``relu(corr_lookup(pyramid, coords) @ weight + bias)`` with the pair
+    batch folded into the query dim (the lookup is purely per-query).
+
+    ``VFT_PROJ_TILE_P`` (perf probes) overrides the VMEM-derived query
+    tile. Read HERE, outside the jit, and passed as a static argument —
+    an env read inside the jitted body would be frozen into the first
+    trace and silently ignored for every later value."""
+    b, h, w, _ = coords.shape
+    cx = coords[..., 0].reshape(1, b * h * w)
+    cy = coords[..., 1].reshape(1, b * h * w)
+    flat = stacked.reshape(1, b * h * w, *stacked.shape[2:])
+    env = os.environ.get("VFT_PROJ_TILE_P", "").strip()
+    out = _corr_lookup_proj_flat(flat, metas, cx, cy, weight, bias,
+                                 radius, interpret,
+                                 tile_p=int(env) if env else None)
+    return out.reshape(b, h, w, -1)
+
+
+def corr_lookup_proj_ref(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
+                         weight: jnp.ndarray, bias: jnp.ndarray,
+                         radius: int = 4) -> jnp.ndarray:
+    """Pure-XLA reference of the fused projection (tests): the unfused
+    composition relu(onehot_lookup @ W + b)."""
+    corr = corr_lookup_onehot(pyramid, coords, radius)
+    return jax.nn.relu(jnp.einsum("bhwk,kc->bhwc", corr, weight) + bias)
+
+
 # ---- lane-dense packed pyramid (opt-in: VFT_CORR_LOOKUP=packed) ----------
 #
 # Measured ~10% SLOWER end-to-end than the per-level default on v5e (see

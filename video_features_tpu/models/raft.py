@@ -112,12 +112,47 @@ class BasicEncoder(nn.Module):
         return nn.Conv(self.output_dim, (1, 1), name="conv2")(x)
 
 
-class BasicMotionEncoder(nn.Module):
-    """update.py:86-104."""
+class _Convc1Params(nn.Module):
+    """Parameter-only twin of ``nn.Conv(256, (1, 1), name='convc1')`` —
+    identical tree path, shapes, and init, so weight transplant and
+    checkpoints are unchanged; the conv itself runs inside the fused
+    Pallas lookup+projection kernel (kernels/corr_lookup.py
+    corr_lookup_proj)."""
+    features: int = 256
+    in_features: int = CORR_LEVELS * (2 * CORR_RADIUS + 1) ** 2
 
     @nn.compact
-    def __call__(self, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
-        cor = nn.relu(nn.Conv(256, (1, 1), name="convc1")(corr))
+    def __call__(self):
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (1, 1, self.in_features, self.features))
+        b = self.param("bias", nn.initializers.zeros, (self.features,))
+        return k, b
+
+
+class BasicMotionEncoder(nn.Module):
+    """update.py:86-104.
+
+    ``fuse_meta`` (static) switches convc1 into the fused Pallas
+    lookup+projection kernel: ``corr`` is then the sublane-stacked pyramid
+    plane (kernels/corr_lookup.py stack_aligned_pyramid) and ``coords``
+    the level-0 query centers — the (B, H, W, 324) lookup intermediate
+    never materializes (round-4 profiling: its relayout boundary cost
+    ~17 ms per 64-pair forward on v5e)."""
+    fuse_meta: Optional[Tuple[Any, ...]] = None
+
+    @nn.compact
+    def __call__(self, flow: jnp.ndarray, corr: jnp.ndarray,
+                 coords: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if self.fuse_meta is not None:
+            from ..kernels import interpret_mode
+            from ..kernels.corr_lookup import corr_lookup_proj
+            k, b = _Convc1Params(name="convc1")()
+            cor = corr_lookup_proj(corr, self.fuse_meta, coords,
+                                   k.reshape(k.shape[2], k.shape[3]), b,
+                                   interpret=interpret_mode())
+            cor = cor.astype(flow.dtype)
+        else:
+            cor = nn.relu(nn.Conv(256, (1, 1), name="convc1")(corr))
         cor = nn.relu(nn.Conv(192, (3, 3), padding=1, name="convc2")(cor))
         flo = nn.relu(nn.Conv(128, (7, 7), padding=3, name="convf1")(flow))
         flo = nn.relu(nn.Conv(64, (3, 3), padding=1, name="convf2")(flo))
@@ -163,21 +198,30 @@ class UpdateIter(nn.Module):
 
     ``corr_meta`` (static) marks the broadcast ``pyramid`` input as
     lane-dense-packed for the fused Pallas lookup (kernels/corr_lookup.py
-    pack_pyramid); ``None`` means raw (B, P, Hl, Wl) levels."""
+    pack_pyramid); ``None`` means raw (B, P, Hl, Wl) levels. ``fuse_meta``
+    (static) marks it as the sublane-stacked plane of the fused
+    lookup+convc1 kernel (the TPU default since round 4)."""
     corr_meta: Optional[Tuple[Any, ...]] = None
+    fuse_meta: Optional[Tuple[Any, ...]] = None
 
     @nn.compact
     def __call__(self, carry, inputs):
         net, coords1 = carry
         pyramid, inp, coords0 = inputs
-        # the lookup runs in f32 (coords + pyramid precision); under bf16
-        # mode its (B,H,W,324) output and the flow join the hidden state's
-        # dtype so the update convs stay on the MXU-native dtype. coords
-        # stay f32 through the carry: delta promotes back on add.
-        corr = corr_lookup(pyramid, coords1,
-                           packed_meta=self.corr_meta).astype(net.dtype)
         flow = (coords1 - coords0).astype(net.dtype)
-        motion = BasicMotionEncoder(name="encoder")(flow, corr)
+        if self.fuse_meta is not None:
+            motion = BasicMotionEncoder(fuse_meta=self.fuse_meta,
+                                        name="encoder")(
+                flow, pyramid, coords1)
+        else:
+            # the lookup runs in f32 (coords + pyramid precision); under
+            # bf16 mode its (B,H,W,324) output and the flow join the hidden
+            # state's dtype so the update convs stay on the MXU-native
+            # dtype. coords stay f32 through the carry: delta promotes back
+            # on add.
+            corr = corr_lookup(pyramid, coords1,
+                               packed_meta=self.corr_meta).astype(net.dtype)
+            motion = BasicMotionEncoder(name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
         net = SepConvGRU(name="gru")(net, x)
         delta = FlowHead(name="flow_head")(net)
@@ -242,6 +286,15 @@ def _corr_impl() -> str:
         raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
                          "'gather', 'onehot', 'pallas' or 'packed'")
     return impl
+
+
+def _fuse_convc1() -> bool:
+    """Trace-time switch for the fused lookup+convc1 kernel on the pallas
+    path (default ON; ``VFT_FUSE_CONVC1=0`` opts out to the per-level
+    unfused kernels — the round-3 configuration, kept for A/B)."""
+    import os
+    return os.environ.get("VFT_FUSE_CONVC1", "1").strip().lower() not in (
+        "0", "false", "no")
 
 
 def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
@@ -433,6 +486,7 @@ class RAFT(nn.Module):
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
         pyramid = build_corr_pyramid(fmap1, fmap2)
         corr_meta = None
+        fuse_meta = None
         impl = _corr_impl()
         if impl == "pallas" and _pallas_supported(pyramid):
             # tile-align the loop-invariant pyramid ONCE, outside the scan:
@@ -441,8 +495,16 @@ class RAFT(nn.Module):
             # ran 20x per forward and cost ~30% of the whole RAFT step
             # (kernels/corr_lookup.py align_level; zero pads are exactly the
             # reference's out-of-range zeros rule)
-            from ..kernels.corr_lookup import align_level
-            pyramid = tuple(align_level(c) for c in pyramid)
+            from ..kernels.corr_lookup import (align_level,
+                                               proj_lookup_supported,
+                                               stack_aligned_pyramid)
+            if _fuse_convc1() and proj_lookup_supported(pyramid):
+                # round-4 default: ONE kernel serves all four levels AND
+                # the motion encoder's convc1 — the 324-channel lookup
+                # intermediate (and its relayout boundary) never exists
+                pyramid, fuse_meta = stack_aligned_pyramid(pyramid)
+            else:
+                pyramid = tuple(align_level(c) for c in pyramid)
             # (measured, not kept as default: a lane-DENSE packed pyramid
             # moves 5.8x fewer bytes but lands ~10% slower end-to-end —
             # the lookup is selection-bound, not DMA-bound. The packed
@@ -470,7 +532,8 @@ class RAFT(nn.Module):
         scanned = nn.scan(
             UpdateIter, variable_broadcast="params",
             split_rngs={"params": False}, in_axes=nn.broadcast,
-            length=self.iters)(corr_meta=corr_meta, name="update_block")
+            length=self.iters)(corr_meta=corr_meta, fuse_meta=fuse_meta,
+                               name="update_block")
         (net, coords1), _ = scanned((net, coords0), (pyramid, inp, coords0))
 
         mask = MaskHead(name="update_mask")(net)

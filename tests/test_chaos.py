@@ -1,0 +1,185 @@
+"""Distributed chaos: the failure modes production hits SIMULTANEOUSLY.
+
+The pieces exist as separate tests — two-process ``distributed=true``
+(test_distributed.py), cross-video batching (test_packer.py), SIGTERM
+preemption + idempotent resume (test_multihost.py), corrupt-input
+isolation (test_sinks.py / safe_extract) — but a preempted spot worker in
+a real fleet experiences them together. This test composes all four:
+
+  two real processes, distributed=true, cross_video_batching=true,
+  one corrupt video in the work list, a mid-run SIGTERM of one worker,
+  then a restart round (fresh coordinator, same shared output dir).
+
+Asserts afterwards: every healthy video's features exist exactly once,
+disjointly owned (hash sharding), loadable and well-shaped; the corrupt
+video produced NO output and is reported failed (not crashed) by exactly
+its owner; the restarted round skips already-done work via the idempotent
+resume contract. Reference behavior anchor: per-video isolation + resume
+in reference models/_base/base_extractor.py:95-127.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.parallel.mesh import local_shard_of_list
+
+TIMEOUT_S = 560
+N_HEALTHY = 6
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_WORKER = textwrap.dedent("""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address={coord!r},
+                               num_processes=2, process_id={pid})
+    from video_features_tpu.cli import main
+    main([
+        "feature_type=r21d", "model_name=r2plus1d_18_16_kinetics",
+        "device=cpu", "distributed=true", "cross_video_batching=true",
+        "clip_batch_size=4", "stack_size=16", "step_size=16",
+        "extraction_fps=2", "allow_random_weights=true",
+        "on_extraction=save_numpy",
+        "output_path={out}", "tmp_path={tmp}",
+        "file_with_video_paths={listfile}",
+    ])
+    print("WORKER_DONE", {pid})
+""")
+
+
+def _spawn(pid, coord, repo, out, tmp, listfile, log_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("VFT_WEIGHTS_DIR", None)
+    script = _WORKER.format(repo=repo, coord=coord, pid=pid, out=out,
+                            tmp=f"{tmp}_{pid}", listfile=listfile)
+    log = open(log_path, "w")
+    # logs to files, never PIPEs (un-drained PIPE deadlock, see
+    # tests/test_multihost.py)
+    return subprocess.Popen([sys.executable, "-c", script], stdout=log,
+                            stderr=subprocess.STDOUT, env=env), log
+
+
+@pytest.mark.slow
+def test_chaos_distributed_preempt_corrupt_resume(sample_video, tmp_path):
+    repo = str(Path(__file__).resolve().parent.parent)
+    videos = []
+    for i in range(N_HEALTHY):
+        dst = tmp_path / f"v_chaos_{i:03d}.mp4"
+        dst.write_bytes(Path(sample_video).read_bytes())
+        videos.append(str(dst))
+    corrupt = tmp_path / "v_chaos_corrupt.mp4"
+    corrupt.write_bytes(b"\x00\x01mp4 junk that cv2 cannot open" * 64)
+    videos.append(str(corrupt))
+    listfile = tmp_path / "videos.txt"
+    listfile.write_text("\n".join(videos) + "\n")
+
+    shards = [set(local_shard_of_list(videos, host_id=i, num_hosts=2))
+              for i in range(2)]
+    assert shards[0] and shards[1] and not (shards[0] & shards[1])
+    victim = 0 if str(corrupt) not in shards[0] else 1
+    corrupt_owner = 1 - victim  # keep the corrupt video on the survivor
+    feat_dir = tmp_path / "out" / "r21d" / "r2plus1d_18_16_kinetics"
+
+    def victim_outputs():
+        return [p for p in feat_dir.glob("*_r21d.npy")
+                if str(tmp_path / p.name.replace("_r21d.npy", ".mp4"))
+                in shards[victim]]
+
+    # ---- round 1: both workers up; SIGTERM the victim mid-run ----------
+    coord = f"127.0.0.1:{_free_port()}"
+    procs, logs = zip(*(_spawn(pid, coord, repo, tmp_path / "out",
+                               tmp_path / "tmp", listfile,
+                               tmp_path / f"r1_worker_{pid}.log")
+                        for pid in range(2)))
+    try:
+        deadline = time.time() + TIMEOUT_S
+        while time.time() < deadline:
+            if victim_outputs():
+                break
+            if procs[victim].poll() is not None:
+                raise AssertionError(
+                    "victim exited before producing output:\n"
+                    + (tmp_path / f"r1_worker_{victim}.log").read_text()[-2000:])
+            time.sleep(0.1)
+        else:
+            raise AssertionError("victim produced no output before deadline")
+        procs[victim].send_signal(signal.SIGTERM)
+        assert procs[victim].wait(timeout=TIMEOUT_S) == 143
+        # the survivor finishes its whole shard (incl. failing the corrupt
+        # video) and exits cleanly — a dead peer must not take it down
+        assert procs[corrupt_owner].wait(timeout=TIMEOUT_S) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    r1_victim = (tmp_path / f"r1_worker_{victim}.log").read_text()
+    assert "SIGTERM: finishing in-flight" in r1_victim
+    r1_surv = (tmp_path / f"r1_worker_{corrupt_owner}.log").read_text()
+    assert "1 failed" in r1_surv, r1_surv[-1500:]
+    done_r1 = {p.name for p in feat_dir.glob("*_r21d.npy")}
+    healthy = {Path(v).stem for v in videos if v != str(corrupt)}
+    assert 0 < len(done_r1) < len(healthy)  # work genuinely remains
+
+    # ---- round 2: restart both under a fresh coordinator ---------------
+    coord = f"127.0.0.1:{_free_port()}"
+    procs, logs = zip(*(_spawn(pid, coord, repo, tmp_path / "out",
+                               tmp_path / "tmp2", listfile,
+                               tmp_path / f"r2_worker_{pid}.log")
+                        for pid in range(2)))
+    try:
+        for p in procs:
+            assert p.wait(timeout=TIMEOUT_S) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    # complete: every healthy video has features; corrupt produced nothing
+    for v in videos:
+        stem = Path(v).stem
+        outs = list(feat_dir.glob(f"{stem}_r21d.npy"))
+        if v == str(corrupt):
+            assert not outs, "corrupt video must not produce features"
+        else:
+            assert len(outs) == 1, f"missing features for {stem}"
+            arr = np.load(outs[0])  # valid: loads, right shape
+            assert arr.ndim == 2 and arr.shape[1] == 512
+
+    # round 2: already-done work skipped (resume), corrupt failed again at
+    # exactly its owner, nothing else failed
+    for pid in range(2):
+        text = (tmp_path / f"r2_worker_{pid}.log").read_text()
+        assert f"WORKER_DONE {pid}" in text, text[-1500:]
+        n_own = len(shards[pid])
+        if pid == corrupt_owner:
+            assert "1 failed" in text, text[-1500:]
+            n_skip = len(done_r1 & {Path(v).stem + "_r21d.npy"
+                                    for v in shards[pid]})
+            assert f"{n_own - 1 - n_skip} extracted, {n_skip} already done, " \
+                   f"1 failed" in text, text[-1500:]
+        else:
+            assert "0 failed" in text, text[-1500:]

@@ -1,0 +1,86 @@
+"""Shared structural contracts across ALL registered extractor families.
+
+Round-3 advisor finding: ``frame_channel_order = 'bgr'`` is an implicit
+contract between a class attribute and the family's host transform
+(extractors/clip_stack.py) — nothing structurally tied them together. This
+suite ties them: for every registered family that streams frames through
+``VideoSource``, extraction with the DECLARED channel order must be
+bit-identical to forcing RGB delivery and inserting an explicit RGB->
+declared-order reorder in front of the same transform. A family that
+declares 'bgr' but whose wiring doesn't actually deliver BGR (or vice
+versa) fails here; a transform that mis-handles the declared order it
+truthfully receives is caught by that family's torch-oracle E2E test.
+"""
+import numpy as np
+import pytest
+
+from video_features_tpu.config import load_config, parse_dotlist, sanity_check
+from video_features_tpu.registry import get_extractor_cls
+
+#: families with a frame_channel_order declaration (clip-stack streaming);
+#: listed explicitly so a NEW family adding the attribute must add itself
+#: here (the test below fails loudly if the lists drift)
+CLIP_STACK_FAMILIES = ["r21d", "s3d"]
+
+
+def _args(family, tmp_path, sample_video):
+    dotlist = [
+        f"feature_type={family}", "device=cpu", "stack_size=10",
+        "step_size=10", "extraction_fps=2", "allow_random_weights=true",
+        f"output_path={tmp_path / 'o'}", f"tmp_path={tmp_path / 't'}",
+        f"video_paths={sample_video}",
+    ]
+    args = load_config(family, parse_dotlist(dotlist))
+    sanity_check(args)
+    return args
+
+
+def test_family_list_covers_every_declarer():
+    """Any registered family declaring frame_channel_order must be in
+    CLIP_STACK_FAMILIES (so the equivalence test below covers it)."""
+    from video_features_tpu.registry import _DISPATCH
+    declared = []
+    for family in _DISPATCH:
+        try:
+            cls = get_extractor_cls(family)
+        except NotImplementedError:
+            continue
+        if "frame_channel_order" in {
+                k for klass in cls.__mro__ for k in vars(klass)}:
+            declared.append(family)
+    # i3d streams via VideoSource directly (default rgb, no declaration)
+    assert sorted(declared) == sorted(CLIP_STACK_FAMILIES), (
+        "families declaring frame_channel_order drifted from the shared "
+        f"contract test: {declared} vs {CLIP_STACK_FAMILIES}")
+
+
+@pytest.mark.parametrize("family", CLIP_STACK_FAMILIES)
+def test_channel_order_wiring_equivalence(family, sample_video, tmp_path,
+                                          monkeypatch):
+    """declared-order delivery == rgb delivery + explicit rgb->declared
+    reorder into the same transform, end to end through extract()."""
+    cls = get_extractor_cls(family)
+    declared = cls.frame_channel_order
+    args = _args(family, tmp_path, sample_video)
+
+    ext = cls(args)
+    native = ext.extract(sample_video)
+
+    monkeypatch.setattr(cls, "frame_channel_order", "rgb")
+    ext_rgb = cls(args)
+    if declared == "bgr":
+        inner = ext_rgb.host_transform
+        assert inner is not None, (
+            f"{family}: declared 'bgr' but has no host transform to "
+            "perform the reorder — the invariant in clip_stack.py is "
+            "unsatisfiable")
+        ext_rgb.host_transform = lambda f: inner(f[..., ::-1])
+    forced = ext_rgb.extract(sample_video)
+
+    assert native.keys() == forced.keys()
+    for key in native:
+        np.testing.assert_array_equal(
+            np.asarray(native[key]), np.asarray(forced[key]),
+            err_msg=f"{family}/{key}: frame_channel_order={declared!r} "
+                    "delivery is not equivalent to rgb delivery + explicit "
+                    "reorder — attribute and transform are out of step")

@@ -230,3 +230,52 @@ def test_channel_order_bgr_is_flipped_rgb(sample_video):
     assert len(rgb) == len(bgr) == 3
     for r, b in zip(rgb, bgr):
         np.testing.assert_array_equal(r, b[:, :, ::-1])
+
+
+def test_grab_skip_resampling_identical(sample_video):
+    """The fps-filter catch-up loop grab()-skips dropped frames (no
+    YUV->BGR conversion/copy for the ~95% discarded at low extraction
+    fps). Frame SELECTION and bytes must be identical to full decode:
+    compare against an index_map-driven full-decode reference."""
+    src = VideoSource(sample_video, fps=2.0)
+    picked = [(idx, f) for f, _, idx in src.frames()]
+    # reference: decode everything, select by the same fps_filter_map
+    full = [f for f, _, _ in VideoSource(sample_video).frames()]
+    from video_features_tpu.utils.io import fps_filter_map, get_video_props
+    props = get_video_props(sample_video)
+    mapping = fps_filter_map(props["num_frames"], props["fps"], 2.0)
+    assert [i for i, _ in picked] == list(range(len(mapping)))
+    assert len(picked) == len(mapping)
+    for (out_idx, frame), src_idx in zip(picked, mapping):
+        np.testing.assert_array_equal(frame, full[src_idx])
+
+
+def test_process_video_source_matches_inline(sample_video):
+    """video_decode=process: the spawned-worker source yields exactly the
+    inline source's frames/timestamps/indices and props, transform applied
+    child-side (picklable callables, ops/host_transforms.py)."""
+    from video_features_tpu.ops.host_transforms import MinSideResize
+    from video_features_tpu.utils.io import ProcessVideoSource
+    tf = MinSideResize(128)
+    inline = VideoSource(sample_video, fps=2.0, transform=tf)
+    proc = ProcessVideoSource(sample_video, fps=2.0, transform=tf)
+    assert proc.fps == inline.fps
+    assert proc.num_frames == inline.num_frames
+    assert (proc.height, proc.width) == (inline.height, inline.width)
+    got = list(proc.frames())
+    want = list(inline.frames())
+    assert len(got) == len(want) > 0
+    for (gf, gt, gi), (wf, wt, wi) in zip(got, want):
+        assert (gt, gi) == (wt, wi)
+        np.testing.assert_array_equal(gf, wf)
+
+
+def test_process_video_source_error_propagates(tmp_path):
+    """A corrupt video fails the PARENT with a per-video error (the chaos
+    contract), not a hung queue."""
+    import pytest as _pytest
+    from video_features_tpu.utils.io import ProcessVideoSource
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"not a video" * 100)
+    with _pytest.raises(RuntimeError, match="decode worker failed"):
+        ProcessVideoSource(str(bad), fps=2.0)

@@ -36,7 +36,24 @@ class BaseExtractor:
             # 'bfloat16' mode keeps the MXU-native fast path instead
             jax.config.update("jax_default_matmul_precision", "highest")
         self.show_pred = bool(args.get("show_pred", False))
+        # video_decode=process: each video's decode+transform runs in a
+        # spawned worker process (utils/io.py ProcessVideoSource) — lifts
+        # the parent-GIL ceiling on numpy/PIL transform work on multi-core
+        # hosts. Default 'inline' (decode on the calling/video_workers
+        # thread).
+        self.video_decode = args.get("video_decode") or "inline"
+        if self.video_decode not in ("inline", "process"):
+            raise NotImplementedError(
+                f"video_decode={self.video_decode!r}: expected 'inline' "
+                "or 'process'")
         self.args = args
+
+    def video_source(self, video_path: str, **kwargs):
+        """Family-agnostic VideoSource factory honoring video_decode."""
+        from ..utils.io import ProcessVideoSource, VideoSource
+        cls = (ProcessVideoSource if self.video_decode == "process"
+               else VideoSource)
+        return cls(video_path, **kwargs)
 
     def _data_mesh(self):
         """Device mesh for this extractor's runners.

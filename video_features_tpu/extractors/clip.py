@@ -23,6 +23,7 @@ import numpy as np
 
 from ..config import Config
 from ..models import clip as clip_model
+from ..ops import host_transforms as ht
 from ..ops import preprocess as pp
 from ..parallel.mesh import (DataParallelApply, TP_RULES_TRANSFORMER,
                              cast_floating, get_mesh, param_specs_by_rules)
@@ -149,11 +150,10 @@ class ExtractCLIP(FrameWiseExtractor):
         self.crop_size = input_size
         self.base_fwd = uint8_fwd
 
-        def transform(rgb: np.ndarray) -> np.ndarray:
-            out = pp.pil_resize(rgb, input_size, interpolation="bicubic")
-            return self.encode_wire_u8(pp.center_crop(out, input_size))
-
-        self.host_transform = transform
+        # a picklable callable (ops/host_transforms.py), not a closure:
+        # video_decode=process ships it to spawned decode workers
+        self.host_transform = ht.ResizeCropTransform(
+            input_size, input_size, "bicubic", self.ingest)
 
         self._text_feats: Optional[np.ndarray] = None
         if self.show_pred:

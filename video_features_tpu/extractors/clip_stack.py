@@ -45,7 +45,15 @@ class ClipStackExtractor(BaseExtractor):
     #: (float conversion, resize, crop) set 'bgr' and reorder channels on
     #: their smallest intermediate instead — this skips a full-resolution
     #: cv2.cvtColor per decoded frame, bit-identically (utils/io.py
-    #: _FrameStream)
+    #: _FrameStream).
+    #:
+    #: INVARIANT (a subclass that overrides either side must keep both in
+    #: step): ``host_transform`` consumes frames in EXACTLY this channel
+    #: order — declaring 'bgr' without the transform performing (or
+    #: deferring) the RGB reorder silently channel-swaps every feature.
+    #: tests/test_extractors_shared.py asserts the wiring equivalence for
+    #: every registered family; the per-family torch-oracle E2E tests pin
+    #: the actual values.
     frame_channel_order = "rgb"
 
     def __init__(self, args: Config, default_stack: int, default_step: int) -> None:
@@ -96,7 +104,8 @@ class ClipStackExtractor(BaseExtractor):
             return self._packer
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
+        src = self.video_source(video_path, batch_size=1,
+                                fps=self.extraction_fps,
                           transform=self.host_transform,
                           channel_order=self.frame_channel_order)
         if self.cross_video:

@@ -105,7 +105,7 @@ class OpticalFlowExtractor(BaseExtractor):
         return self._cached_resize_runner((in_h, in_w), build)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        video = VideoSource(
+        video = self.video_source(
             video_path,
             batch_size=self.batch_size + 1,  # N+1 frames -> N flows
             fps=self.extraction_fps,

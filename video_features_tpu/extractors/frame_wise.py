@@ -106,7 +106,7 @@ class FrameWiseExtractor(BaseExtractor):
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         device_resize = self.resize_mode == "device"
-        video = VideoSource(
+        video = self.video_source(
             video_path,
             batch_size=self.batch_size,
             fps=self.extraction_fps,

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..config import Config
 from ..models import i3d as i3d_model
+from ..ops import host_transforms as ht
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.io import Prefetcher, VideoSource
@@ -91,10 +92,11 @@ class ExtractI3D(BaseExtractor):
         if "flow" in self.streams:
             self._init_flow_stream(args, mesh, dtype, allow_random)
 
-        def transform(rgb: np.ndarray) -> np.ndarray:
-            # ResizeImproved(256) smaller-edge PIL bilinear, kept uint8
-            # (extract_i3d.py:41-46; PILToTensor+ToFloat only change layout)
-            return pp.pil_resize(rgb, self.min_side_size)
+        # ResizeImproved(256) smaller-edge PIL bilinear, kept uint8
+        # (extract_i3d.py:41-46; PILToTensor+ToFloat only change layout).
+        # A picklable callable (ops/host_transforms.py), not a closure:
+        # video_decode=process ships it to spawned decode workers.
+        transform = ht.MinSideResize(self.min_side_size)
 
         # resize=device: the 256-edge PIL filtering (~1.3 ms/frame/core) is
         # the host bottleneck for this family; run it as coefficient matmuls
@@ -149,7 +151,8 @@ class ExtractI3D(BaseExtractor):
             self, args, mesh, dtype, allow_random)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        src = VideoSource(video_path, batch_size=1, fps=self.extraction_fps,
+        src = self.video_source(video_path, batch_size=1,
+                                fps=self.extraction_fps,
                           transform=self.host_transform)
         frames: List[np.ndarray] = []
         stacks: List[np.ndarray] = []

@@ -17,6 +17,7 @@ import numpy as np
 from ..config import Config
 from ..models import r21d as r21d_model
 from ..ops import colorspace
+from ..ops import host_transforms as ht
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
@@ -80,17 +81,9 @@ class ExtractR21D(ClipStackExtractor):
             cast_floating(params["backbone"], dtype),
             mesh=mesh, fixed_batch=self.clip_batch_size)
 
-        def transform(bgr: np.ndarray) -> np.ndarray:
-            # frames arrive in decoder-native BGR (frame_channel_order);
-            # float/resize/crop are channel-independent, so the RGB reorder
-            # happens on the 112px crop — 6x fewer pixels than a
-            # full-resolution cvtColor, bit-identical result
-            x = bgr.astype(np.float32) / 255.0
-            x = pp.bilinear_resize_no_antialias(x, (128, 171))
-            x = np.ascontiguousarray(pp.center_crop(x, 112)[:, :, ::-1])
-            return self.encode_wire(x)
-
-        self.host_transform = transform
+        # a picklable callable (ops/host_transforms.py), not a closure:
+        # video_decode=process ships it to spawned decode workers
+        self.host_transform = ht.R21DTransform(self.ingest)
 
     def maybe_show_pred(self, feats: np.ndarray, slices, group=None) -> None:
         if self.show_pred:

@@ -14,6 +14,7 @@ import numpy as np
 
 from ..config import Config
 from ..models import resnet as resnet_model
+from ..ops import host_transforms as ht
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
@@ -75,11 +76,10 @@ class ExtractResNet(FrameWiseExtractor):
         self.crop_size = 224
         self.base_fwd = uint8_fwd
 
-        def transform(rgb: np.ndarray) -> np.ndarray:
-            out = pp.pil_resize(rgb, 256, interpolation="bilinear")
-            return self.encode_wire_u8(pp.center_crop(out, 224))
-
-        self.host_transform = transform
+        # a picklable callable (ops/host_transforms.py), not a closure:
+        # video_decode=process ships it to spawned decode workers
+        self.host_transform = ht.ResizeCropTransform(256, 224, "bilinear",
+                                                     self.ingest)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         if self.show_pred:

@@ -16,6 +16,7 @@ import numpy as np
 from ..config import Config
 from ..models import s3d as s3d_model
 from ..ops import colorspace
+from ..ops import host_transforms as ht
 from ..ops import preprocess as pp
 from ..parallel.mesh import DataParallelApply, cast_floating, get_mesh
 from ..utils.labels import show_predictions_on_dataset
@@ -72,17 +73,9 @@ class ExtractS3D(ClipStackExtractor):
             params, mesh=mesh, fixed_batch=self.clip_batch_size) \
             if self.show_pred else None
 
-        def transform(bgr: np.ndarray) -> np.ndarray:
-            # decoder-native BGR in (frame_channel_order); the RGB reorder
-            # happens on the 224px crop instead of the full-resolution
-            # frame — bit-identical, one less conversion pass per frame
-            x = bgr.astype(np.float32) / 255.0
-            scale = 224.0 / min(x.shape[0], x.shape[1])
-            x = pp.bilinear_resize_by_scale(x, scale)
-            x = np.ascontiguousarray(pp.center_crop(x, 224)[:, :, ::-1])
-            return self.encode_wire(x)
-
-        self.host_transform = transform
+        # a picklable callable (ops/host_transforms.py), not a closure:
+        # video_decode=process ships it to spawned decode workers
+        self.host_transform = ht.S3DTransform(self.ingest)
 
     def maybe_show_pred(self, feats: np.ndarray, slices, group=None) -> None:
         # the reference runs the model a second time with features=False on

@@ -138,8 +138,12 @@ class ClipPacker:
                 to_flush = None
                 with self._lock:
                     # pending counts buffered AND in-flight clips, so zero
-                    # means everything of ours has materialized
-                    if self._pending[handle] == 0:
+                    # means everything of ours has materialized. A poisoned
+                    # handle breaks out regardless of the count — the error
+                    # (raised below) is the result, and waiting on counts a
+                    # failed drain may not have balanced would hang instead
+                    # of surfacing it.
+                    if self._pending[handle] == 0 or handle in self._errors:
                         break
                     if not self._inflight:
                         if self._buf and self._closing >= self._open:
@@ -222,14 +226,19 @@ class ClipPacker:
                 if not self._inflight:
                     return
                 dev, manifest = self._inflight.popleft()
+            # ANY failure after the pop (the blocking D2H is the expected
+            # one, but also e.g. a routing bug below) must poison the
+            # members — once the group left _inflight, nobody else can
+            # materialize it, and un-poisoned members would spin in
+            # close_video forever instead of surfacing the error
             try:
                 host = np.asarray(dev)  # blocking D2H
+                with self._lock:
+                    for row, (h, idx) in enumerate(manifest):
+                        if h in self._results:
+                            self._results[h][idx] = host[row]
+                            self._pending[h] -= 1
+                    self._cond.notify_all()
             except Exception as e:
                 self._poison(manifest, e)
                 raise
-            with self._lock:
-                for row, (h, idx) in enumerate(manifest):
-                    if h in self._results:
-                        self._results[h][idx] = host[row]
-                        self._pending[h] -= 1
-                self._cond.notify_all()

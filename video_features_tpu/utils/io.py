@@ -143,6 +143,21 @@ class _FrameStream:
             return frame
         return cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
 
+    def skip(self) -> bool:
+        """Advance one frame WITHOUT materializing it: ``grab()`` demuxes
+        and decodes (inter-frame dependencies need that) but skips
+        ``retrieve()``'s YUV->BGR conversion + frame copy. At
+        extraction_fps=1 from a ~20 fps source, ~95% of frames are dropped
+        by the fps filter — they pay decode only, never conversion.
+        Same frame-0 retry as :meth:`read` (the missing-frame-0 workaround
+        shifts indices identically on both paths)."""
+        ok = self.cap.grab()
+        if not ok and self._first:
+            print("Detect missing frame")
+            ok = self.cap.grab()
+        self._first = False
+        return ok
+
     def release(self):
         if self.cap is not None:
             self.cap.release()
@@ -166,6 +181,10 @@ class VideoSource:
                  channel_order: str = "rgb"):
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
+        # eager: _FrameStream re-checks lazily at first decode, but that
+        # fires inside a worker thread as a per-video failure, far from the
+        # misconfigured call site
+        assert channel_order in ("rgb", "bgr"), channel_order
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
         self.path = str(path)
@@ -238,7 +257,16 @@ class VideoSource:
                 current = None
                 for out_idx, want in enumerate(self.index_map):
                     while src_idx < want:
-                        nxt = timed_read()
+                        if src_idx < want - 1:
+                            # this source frame is dropped by the fps
+                            # filter: grab()-skip it (no conversion/copy,
+                            # see _FrameStream.skip)
+                            with profiler.stage("decode"):
+                                ok = stream.skip()
+                            nxt = True if ok else None
+                        else:
+                            nxt = timed_read()
+                            current = nxt
                         if nxt is None:
                             # container metadata overstated the frame count;
                             # reaching stream end inside this loop always
@@ -249,33 +277,150 @@ class VideoSource:
                                   f"{out_idx}/{len(self.index_map)} "
                                   "resampled frames.")
                             return
-                        current = nxt
                         src_idx += 1
                     yield emit(current, out_idx)
         finally:
             stream.release()
 
     def __iter__(self) -> Iterator[Tuple[List, List[float], List[int]]]:
-        batch: List = []
-        times: List[float] = []
-        indices: List[int] = []
-        fresh = 0  # frames added since the last yield (excludes carried overlap)
-        for x, ts, idx in self.frames():  # frames() already applies transform
-            batch.append(x)
-            times.append(ts)
-            indices.append(idx)
-            fresh += 1
-            if len(batch) == self.batch_size:
-                yield batch, times, indices
-                keep = self.overlap
-                batch = batch[len(batch) - keep:] if keep else []
-                times = times[len(times) - keep:] if keep else []
-                indices = indices[len(indices) - keep:] if keep else []
-                fresh = 0
-        # the last batch may be short, but a batch of only carried-over
-        # overlap frames is never emitted (reference utils/io.py:109-146)
-        if fresh > 0:
+        return _batched(self.frames(), self.batch_size, self.overlap)
+
+
+def _batched(frames: Iterator[Tuple[np.ndarray, float, int]],
+             batch_size: int, overlap: int
+             ) -> Iterator[Tuple[List, List[float], List[int]]]:
+    """Batch a ``frames()`` stream (shared by VideoSource and
+    ProcessVideoSource, whose frame iteration differs but whose batching
+    contract must not)."""
+    batch: List = []
+    times: List[float] = []
+    indices: List[int] = []
+    fresh = 0  # frames added since the last yield (excludes carried overlap)
+    for x, ts, idx in frames:  # frames() already applies transform
+        batch.append(x)
+        times.append(ts)
+        indices.append(idx)
+        fresh += 1
+        if len(batch) == batch_size:
             yield batch, times, indices
+            keep = overlap
+            batch = batch[len(batch) - keep:] if keep else []
+            times = times[len(times) - keep:] if keep else []
+            indices = indices[len(indices) - keep:] if keep else []
+            fresh = 0
+    # the last batch may be short, but a batch of only carried-over
+    # overlap frames is never emitted (reference utils/io.py:109-146)
+    if fresh > 0:
+        yield batch, times, indices
+
+
+def _decode_worker(q, path: str, kwargs: dict) -> None:
+    """ProcessVideoSource child body: decode + transform only.
+
+    Runs in a SPAWNED interpreter whose imports stay light (numpy / cv2 /
+    PIL via ops.host_transforms) — jax must never initialize here: on
+    hosts whose sitecustomize injects an accelerator platform into every
+    process, a jax op in a child could claim the single TPU chip out from
+    under the parent."""
+    try:
+        src = VideoSource(path, **kwargs)
+        q.put(("props", {"fps": src.fps, "src_fps": src.src_fps,
+                         "num_frames": src.num_frames,
+                         "src_num_frames": src.src_num_frames,
+                         "height": src.height, "width": src.width}))
+        for item in src.frames():
+            q.put(("frame", item))
+        q.put(("done", None))
+    except BaseException as e:
+        try:
+            q.put(("error", f"{type(e).__name__}: {e}"))
+        except Exception:
+            pass
+
+
+class ProcessVideoSource:
+    """``VideoSource`` twin whose decode + transform run in a spawned
+    worker process (``video_decode=process``).
+
+    Threads (`video_workers`) overlap cv2 decode with device compute, but
+    the numpy/PIL *transform* work still serializes on the parent's GIL;
+    on multi-core hosts a decode PROCESS per in-flight video removes that
+    ceiling. The spawned child imports only the light decode stack and
+    ships transformed frames (already resized/cropped — tens of KB each,
+    not raw full-resolution) through a bounded queue; the parent keeps all
+    device work. Spawn + import costs ~1-2 s per video, so this pays off
+    for long videos and multi-core CPU-bound pipelines — it is opt-in
+    (docs/performance.md).
+
+    Same observable surface as VideoSource: ``fps``/``num_frames``/
+    ``height``/``width`` props, ``frames()``, batched ``__iter__``,
+    transform applied child-side. Requires a PICKLABLE transform
+    (ops/host_transforms.py — every built-in family's is).
+    """
+
+    def __init__(self, path: Union[str, Path], batch_size: int = 1,
+                 fps: Optional[float] = None, total: Optional[int] = None,
+                 transform: Optional[Callable] = None, overlap: int = 0,
+                 channel_order: str = "rgb", depth: int = 16,
+                 start_timeout_s: float = 120.0):
+        import multiprocessing as mp
+        self.path = str(path)
+        self.batch_size = batch_size
+        self.overlap = overlap
+        ctx = mp.get_context("spawn")  # never fork a process holding jax
+        self._q = ctx.Queue(maxsize=max(int(depth), 2))
+        self._proc = ctx.Process(
+            target=_decode_worker,
+            args=(self._q, self.path,
+                  dict(batch_size=1, fps=fps, total=total,
+                       transform=transform, overlap=0,
+                       channel_order=channel_order)),
+            daemon=True)
+        self._proc.start()
+        tag, payload = self._q.get(timeout=start_timeout_s)
+        if tag == "error":
+            self.release()
+            raise RuntimeError(
+                f"decode worker failed for {self.path}: {payload}")
+        assert tag == "props", tag
+        self.fps = payload["fps"]
+        self.src_fps = payload["src_fps"]
+        self.num_frames = payload["num_frames"]
+        self.src_num_frames = payload["src_num_frames"]
+        self.height = payload["height"]
+        self.width = payload["width"]
+
+    def __len__(self):
+        return self.num_frames
+
+    def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
+        try:
+            while True:
+                tag, payload = self._q.get()
+                if tag == "frame":
+                    yield payload
+                elif tag == "done":
+                    return
+                else:
+                    raise RuntimeError(
+                        f"decode worker failed for {self.path}: {payload}")
+        finally:
+            self.release()
+
+    def __iter__(self) -> Iterator[Tuple[List, List[float], List[int]]]:
+        return _batched(self.frames(), self.batch_size, self.overlap)
+
+    def release(self) -> None:
+        proc, self._proc = self._proc, None
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=10)
+
+    def __del__(self):  # abandoned mid-video (per-video error isolation)
+        try:
+            self.release()
+        except Exception:
+            pass
 
 
 class Prefetcher:

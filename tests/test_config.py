@@ -6,6 +6,8 @@ import pytest
 from video_features_tpu.config import (Config, load_config, merge,
                                        parse_dotlist, sanity_check)
 
+pytestmark = pytest.mark.quick
+
 
 def test_dotlist_parsing_types():
     cfg = parse_dotlist([

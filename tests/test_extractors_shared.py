@@ -22,11 +22,16 @@ from video_features_tpu.registry import get_extractor_cls
 #: here (the test below fails loudly if the lists drift)
 CLIP_STACK_FAMILIES = ["r21d", "s3d"]
 
+#: minimum viable stack per family: s3d's 8x temporal downsampling needs
+#: >=16 frames to leave >1 temporal position at the head (models/s3d.py)
+STACK_SIZE = {"r21d": 10, "s3d": 16}
+
 
 def _args(family, tmp_path, sample_video):
+    stack = STACK_SIZE[family]
     dotlist = [
-        f"feature_type={family}", "device=cpu", "stack_size=10",
-        "step_size=10", "extraction_fps=2", "allow_random_weights=true",
+        f"feature_type={family}", "device=cpu", f"stack_size={stack}",
+        f"step_size={stack}", "extraction_fps=2", "allow_random_weights=true",
         f"output_path={tmp_path / 'o'}", f"tmp_path={tmp_path / 't'}",
         f"video_paths={sample_video}",
     ]

@@ -6,6 +6,8 @@ from video_features_tpu.utils.io import (VideoSource, fps_filter_map,
                                          get_video_props, read_video_frames)
 from video_features_tpu.utils.lists import form_slices
 
+pytestmark = pytest.mark.quick
+
 
 def test_video_props(sample_video):
     props = get_video_props(sample_video)

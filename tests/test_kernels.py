@@ -15,6 +15,8 @@ from video_features_tpu.kernels.corr_lookup import (corr_lookup_onehot,
 from video_features_tpu.models.raft import (build_corr_pyramid,
                                              corr_lookup_gather)
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.mark.parametrize("b,h,w,c", [
     (1, 16, 24, 32),     # even tiling

@@ -8,8 +8,9 @@ from video_features_tpu import native
 from video_features_tpu.utils.io import Prefetcher
 from video_features_tpu.utils import sinks
 
-pytestmark = pytest.mark.skipif(not native.available(),
-                                reason="native toolchain unavailable")
+pytestmark = [pytest.mark.quick,
+              pytest.mark.skipif(not native.available(),
+                                 reason="native toolchain unavailable")]
 
 
 @pytest.mark.parametrize("arr", [

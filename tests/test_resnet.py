@@ -16,6 +16,8 @@ import jax.numpy as jnp  # noqa: E402
 from video_features_tpu.models import resnet as rn  # noqa: E402
 from tests.torch_oracles import TorchResNet  # noqa: E402
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.mark.parametrize("variant", ["resnet18", "resnet50"])
 def test_flax_matches_torch_oracle(variant):

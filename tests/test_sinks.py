@@ -1,7 +1,10 @@
 """Output sinks: file naming, idempotent skip, corruption re-extraction."""
 import numpy as np
+import pytest
 
 from video_features_tpu.utils import sinks
+
+pytestmark = pytest.mark.quick
 
 
 def test_make_path_contract(tmp_path):

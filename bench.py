@@ -47,6 +47,7 @@ Measurement notes, learned the hard way on tunneled dev chips:
     coin flip.
 """
 import json
+import os
 import sys
 import time
 
@@ -683,9 +684,39 @@ def main() -> None:
         print(f"WARNING: pipeline bench failed: {type(e).__name__}: {e}",
               file=__import__("sys").stderr)
 
+    # Full-fidelity record (notes, baselines, every row) goes to a repo
+    # file: the driver keeps only the LAST 2,000 chars of stdout, which in
+    # round 4 truncated the r21d/i3d headline rows out of BENCH_r04.json.
+    # The driver commits uncommitted work at end of round, so this file is
+    # always recoverable from the repo afterwards.
+    full_name = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_full.json"), "w") as f:
+            json.dump({**r21d_entry, "metrics": metrics}, f, indent=1)
+            f.write("\n")
+        full_name = "BENCH_full.json"
+    except OSError as e:
+        # never lose the already-measured results to a disk/permission
+        # failure on the side file — the stdout line below is the contract
+        print(f"WARNING: BENCH_full.json write failed: {e}", file=sys.stderr)
+
     # one JSON line: headline fields stay the r21d config (driver contract
-    # since round 1); "metrics" carries the north-star configs + pipeline
-    print(json.dumps({**r21d_entry, "metrics": metrics}))
+    # since round 1); "metrics" carries the north-star configs + pipeline,
+    # compacted (no note/baseline prose, row 1 deduped into the top level)
+    # so the WHOLE line fits in the driver's 2,000-char tail capture
+    def compact(row):
+        return {k: v for k, v in row.items()
+                if k in ("metric", "value", "unit", "vs_baseline")
+                and v is not None}
+    line = {**compact(metrics[0]),
+            # the driver contract names all four headline keys, so
+            # vs_baseline stays present even when the torch baseline failed
+            "vs_baseline": r21d_entry["vs_baseline"],
+            "metrics": [compact(r) for r in metrics[1:]]}
+    if full_name:
+        line["full"] = full_name
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -18,6 +18,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.quick
+
 REPO = str(Path(__file__).resolve().parent.parent)
 
 

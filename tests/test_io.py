@@ -281,3 +281,21 @@ def test_process_video_source_error_propagates(tmp_path):
     bad.write_bytes(b"not a video" * 100)
     with _pytest.raises(RuntimeError, match="decode worker failed"):
         ProcessVideoSource(str(bad), fps=2.0)
+
+
+def test_process_video_source_killed_worker_raises(sample_video):
+    """A worker killed without running its except handler (OOM SIGKILL)
+    must fail the video, not hang the parent on an untimed queue get
+    (advisor r4). The timed get + liveness check turns it into the same
+    per-video RuntimeError as a decode failure."""
+    import os
+    import signal
+    import pytest as _pytest
+    from video_features_tpu.utils.io import ProcessVideoSource
+    src = ProcessVideoSource(sample_video, fps=2.0, depth=2)
+    it = src.frames()
+    next(it)  # worker is up and decoding
+    os.kill(src._proc.pid, signal.SIGKILL)
+    with _pytest.raises(RuntimeError, match="died without a result"):
+        for _ in it:  # drain whatever was queued, then hit the dead worker
+            pass

@@ -62,10 +62,28 @@ def _pwc_quantized_flow(model, crop: int, params, pairs_u8):
 
 #: HBM budget for one pair-batch forward's correlation pyramid — the
 #: dominant RAFT allocation, (pairs, P, Hsum, Wp) f32 (kernels/corr_lookup
-#: stack_aligned_pyramid). 7 GB picks 4 stacks/forward at the 224px
-#: flagship geometry (6.6 GB, measured fine on 16 GB v5e incl. towers) and
-#: scales down automatically for larger source resolutions.
-_FLOW_PYRAMID_BUDGET = 7 * 1024 ** 3
+#: stack_aligned_pyramid). The fallback 7 GiB picks 4 stacks/forward at the
+#: 224px flagship geometry (6.6 GB, measured fine on 16 GB v5e incl.
+#: towers) and scales down automatically for larger source resolutions.
+_FLOW_PYRAMID_BUDGET_FALLBACK = 7 * 1024 ** 3
+
+
+def _flow_pyramid_budget() -> int:
+    """Size the pyramid budget from the actual device HBM when the runtime
+    reports it (advisor r4: the 7 GiB constant assumed a 16 GB v5e — a
+    smaller-HBM chip would OOM at k=4, a larger one under-batch). Uses the
+    same 7/16 fraction the measured v5e number embodied; falls back to the
+    constant when memory_stats is unavailable (CPU backend, older runtimes).
+    """
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit * 7 / 16)
+    except Exception:
+        pass
+    return _FLOW_PYRAMID_BUDGET_FALLBACK
 
 
 def _stacks_per_forward(t: int, h: int, w: int, cap: int = 4) -> int:
@@ -81,8 +99,9 @@ def _stacks_per_forward(t: int, h: int, w: int, cap: int = 4) -> int:
     h8, w8 = -(-h // 8), -(-w // 8)  # RAFT pads inputs to /8 (InputPadder)
     per_stack = t * (h8 * w8) * 4 * stacked_plane_cells(
         h8, w8, levels=raft_model.CORR_LEVELS)
+    budget = _flow_pyramid_budget()
     k = 1
-    while k * 2 <= cap and (k * 2) * per_stack <= _FLOW_PYRAMID_BUDGET:
+    while k * 2 <= cap and (k * 2) * per_stack <= budget:
         k *= 2
     return k
 

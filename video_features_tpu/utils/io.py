@@ -377,7 +377,11 @@ class ProcessVideoSource:
                        channel_order=channel_order)),
             daemon=True)
         self._proc.start()
-        tag, payload = self._q.get(timeout=start_timeout_s)
+        try:
+            tag, payload = self._q.get(timeout=start_timeout_s)
+        except BaseException:
+            self.release()  # don't leak the just-spawned process
+            raise
         if tag == "error":
             self.release()
             raise RuntimeError(
@@ -394,9 +398,29 @@ class ProcessVideoSource:
         return self.num_frames
 
     def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
+        import queue as _queue
         try:
             while True:
-                tag, payload = self._q.get()
+                try:
+                    tag, payload = self._q.get(timeout=5.0)
+                except _queue.Empty:
+                    # a worker killed without running its except handler
+                    # (OOM SIGKILL) can never enqueue 'error'/'done' — fail
+                    # the video instead of hanging the extraction thread
+                    if self._proc is not None and self._proc.is_alive():
+                        continue
+                    # the worker may have flushed its tail (frames + 'done')
+                    # and exited in the instant between the timeout and the
+                    # liveness check: drain before declaring it dead
+                    try:
+                        tag, payload = self._q.get_nowait()
+                        # fall through to the normal tag handling below
+                    except _queue.Empty:
+                        raise RuntimeError(
+                            f"decode worker for {self.path} died without a "
+                            "result (killed? exitcode="
+                            f"{getattr(self._proc, 'exitcode', None)})"
+                        ) from None
                 if tag == "frame":
                     yield payload
                 elif tag == "done":
@@ -412,9 +436,13 @@ class ProcessVideoSource:
 
     def release(self) -> None:
         proc, self._proc = self._proc, None
-        if proc is not None and proc.is_alive():
+        if proc is None:
+            return
+        if proc.is_alive():
             proc.terminate()
-            proc.join(timeout=10)
+        # join even a cleanly-exited worker: without it the child stays a
+        # zombie until multiprocessing's lazy reaping, one per video
+        proc.join(timeout=10)
 
     def __del__(self):  # abandoned mid-video (per-video error isolation)
         try:

@@ -35,22 +35,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from video_features_tpu.weights.store import HUB_FILENAMES  # noqa: E402
-
-#: full published SHA-256 digests: the OpenAI CDN embeds them in the
-#: download URL path (reference models/clip/clip_src/clip.py:32-42 and its
-#: _download() which verifies exactly this digest)
-CLIP_SHA256 = {
-    "RN50.pt": "afeb0e10f9e5a86da6080e35cf09123aca3b358a0c3e3b6c78a7b63bc04b6762",
-    "RN101.pt": "8fa8567bab74a42d41c5915025a8e4538c3bdbe8804a470a72f30b0d94fab599",
-    "RN50x4.pt": "7e526bd135e493cef0776de27d5f42653e6b4c8bf9e0f653bb11773263205fdd",
-    "RN50x16.pt": "52378b407f34354e150460fe41077663dd5b39c54cd0bfd2b27167a4a06ec9aa",
-    "RN50x64.pt": "be1cfb55d75a9666199fb2206c106743da0f6468c9d327f3e0d0a543a9919d9c",
-    "ViT-B-32.pt": "40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af",
-    "ViT-B-16.pt": "5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f",
-    "ViT-L-14.pt": "b8cca3fd41ae0c99ba7e8951adf17d267cdb84cd88be6f7c2e0eca1737a03836",
-    "ViT-L-14-336px.pt": "3035c92b350959924f9f00213499208652fc7ea050643e8b385c2dac08641f02",
-}
+from video_features_tpu.weights.store import (  # noqa: E402
+    HUB_FILENAMES, WEIGHT_URLS, expected_digest)
 
 #: which golden families each model key unlocks (mirror of
 #: tests/test_golden.py _weight_keys, inverted)
@@ -70,26 +56,14 @@ KEY_FAMILIES = {
 }
 
 
-def _expected_digest(fname: str):
-    """(kind, digest) — 'sha256' full, 'sha256-prefix' from torch-hub
-    release filenames (name-<8hex>.pth), or (None, None)."""
-    if fname in CLIP_SHA256:
-        return "sha256", CLIP_SHA256[fname]
-    stem = Path(fname).stem
-    if "-" in stem:
-        tail = stem.rsplit("-", 1)[1]
-        if len(tail) == 8 and all(c in "0123456789abcdef" for c in tail):
-            return "sha256-prefix", tail
-    return None, None
-
-
 def want_list() -> list:
     rows = []
     for key, fnames in sorted(HUB_FILENAMES.items()):
         for fname in fnames:
-            kind, digest = _expected_digest(fname)
+            kind, digest = expected_digest(fname)
             rows.append({"model_key": key, "filename": fname,
                          "unlocks": KEY_FAMILIES.get(key, "?"),
+                         "url": WEIGHT_URLS.get(fname),
                          "digest": f"{kind}:{digest}" if digest else
                          "none published (repo-local blob)"})
     return rows
@@ -115,7 +89,7 @@ def scan(directory: Path) -> dict:
                 continue
             status = "not checked (converted cache)" \
                 if p.suffix == ".msgpack" else "no published digest"
-            kind, digest = _expected_digest(p.name)
+            kind, digest = expected_digest(p.name)
             if p.suffix != ".msgpack" and digest:
                 got = _sha256(p)
                 ok = got == digest if kind == "sha256" \
@@ -224,16 +198,68 @@ def main() -> None:
                 print(f"  still missing ({len(missing_keys)} keys): "
                       + ", ".join(missing_keys))
 
+    # ---- per-family readiness: found / converted / golden-value pass ----
+    def _base_family(key: str) -> str:
+        label = KEY_FAMILIES.get(key, "?")
+        return label.split()[0].split("(")[0]
+
+    readiness = {}
+    for key in HUB_FILENAMES:
+        fam = _base_family(key)
+        row = readiness.setdefault(
+            fam, {"found": [], "missing": [], "converted": [],
+                  "convert_errors": [], "golden_value_pass": None})
+        if key in found:
+            row["found"].append(key)
+            conv = report.get("conversion", {}).get(key, "")
+            ok = conv.startswith(("converted", "already converted",
+                                  "no conversion needed"))
+            (row["converted"] if ok else row["convert_errors"]).append(
+                key if ok else f"{key}: {conv}")
+        else:
+            row["missing"].append(key)
+
+    rc = 0
     if found and not args.no_golden:
         print("\n== golden VALUE-tier run (VFT_WEIGHTS_DIR="
               f"{directory}) ==", flush=True)
         env = dict(os.environ, VFT_WEIGHTS_DIR=str(directory),
                    JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
-        rc = subprocess.call(
+        proc = subprocess.run(
             [sys.executable, "-m", "pytest", "tests/test_golden.py",
              "-q", "-rs", "-s"],
-            cwd=str(Path(__file__).resolve().parent.parent), env=env)
-        sys.exit(rc)
+            cwd=str(Path(__file__).resolve().parent.parent), env=env,
+            capture_output=True, text=True)
+        rc = proc.returncode
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        # the coverage report prints one "  value: {family}-{variant}" line
+        # per value-verified variant (tests/test_golden.py); pass/fail is
+        # judged PER FAMILY — one family's red must not mark the others
+        # unverified — by also parsing pytest's FAILED ids
+        value_fams = {ln.split("value:", 1)[1].strip().split("-")[0]
+                      for ln in proc.stdout.splitlines()
+                      if ln.strip().startswith("value:")}
+        failed_fams = set()
+        for ln in proc.stdout.splitlines():
+            if "FAILED" in ln and "test_golden_variant[" in ln:
+                failed_fams.add(
+                    ln.split("test_golden_variant[", 1)[1].split("-")[0])
+        for fam, row in readiness.items():
+            if row["found"]:
+                row["golden_value_pass"] = (fam in value_fams
+                                            and fam not in failed_fams)
+
+    out = directory / "readiness.json"
+    with open(out, "w") as f:
+        json.dump(readiness, f, indent=1, sort_keys=True)
+        f.write("\n")
+    ready = sorted(f for f, r in readiness.items() if r["golden_value_pass"])
+    print(f"\nreadiness report -> {out}")
+    print(f"value-verified families: {ready or 'none'}")
+    print("(enforce with VFT_REQUIRE_VALUE_TIER=" +
+          ",".join(ready or ["fam1,fam2"]) + " pytest tests/test_golden.py)")
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

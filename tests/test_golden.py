@@ -343,6 +343,25 @@ def test_golden_variant(group, golden_sample, tmp_path_factory):
         tmp_path_factory.mktemp("golden"))
     _TIER_LOG[f"{family}-{variant}"] = "value" if value_tier else "shape"
 
+    # VFT_REQUIRE_VALUE_TIER=fam1,fam2 (or 'all'): a required family
+    # silently falling back to the shape tier is a FAILURE, not a quieter
+    # pass — the contract a weights-arrival run needs (VERDICT r4 #7)
+    required = {f.strip() for f in
+                os.environ.get("VFT_REQUIRE_VALUE_TIER", "").split(",")
+                if f.strip()}
+    if not value_tier and ("all" in required or family in required):
+        from video_features_tpu.weights import store
+        ref_args = next(iter(refs.values()))["args"]
+        keys = _weight_keys(family, ref_args)
+        missing = [k for k in keys if store.find_checkpoint(k) is None]
+        why = (f"checkpoints are missing for {missing}" if missing else
+               "the variant is pinned to the shape tier for a non-weight "
+               "reason (vggish with no ffmpeg to rip real audio)")
+        pytest.fail(
+            f"{family}/{variant}: VFT_REQUIRE_VALUE_TIER demands value-"
+            f"level verification but {why} — the run would have silently "
+            "downgraded to the shape tier")
+
     for key, ref in refs.items():
         want = ref["data"]
         assert key in out, f"extractor output is missing key {key!r}"

@@ -1,0 +1,135 @@
+"""Opt-in weight fetch: VFT_FETCH_WEIGHTS gating, SHA-256 refusal, and the
+VFT_REQUIRE_VALUE_TIER golden contract.
+
+The reference auto-downloads with digest verification (its CLIP loader
+refuses a mismatched SHA-256, reference models/clip/clip_src/clip.py:61-73);
+this suite pins the same refusal semantics onto ``store.fetch_checkpoint``
+without any network: ``urllib.request.urlopen`` is monkeypatched to serve
+canned bytes.
+"""
+import hashlib
+import io
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from video_features_tpu.weights import store
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+PAYLOAD = b"synthetic checkpoint bytes" * 64
+PAYLOAD_SHA = hashlib.sha256(PAYLOAD).hexdigest()
+
+
+class _FakeResponse(io.BytesIO):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@pytest.fixture
+def fake_upstream(tmp_path, monkeypatch):
+    """A synthetic model key served from a patched urlopen, weights_dir
+    redirected to tmp_path. Returns the key."""
+    key = "fake_model"
+    monkeypatch.setitem(store.HUB_FILENAMES, key, ("fake-model.pt",))
+    monkeypatch.setitem(store.WEIGHT_URLS, "fake-model.pt",
+                        "https://example.invalid/fake-model.pt")
+    monkeypatch.setitem(store.CLIP_SHA256, "fake-model.pt", PAYLOAD_SHA)
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path))
+
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(url)
+        return _FakeResponse(PAYLOAD)
+
+    import urllib.request
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    return key, calls
+
+
+def test_no_fetch_without_flag(fake_upstream, monkeypatch):
+    key, calls = fake_upstream
+    monkeypatch.delenv("VFT_FETCH_WEIGHTS", raising=False)
+    assert store.find_checkpoint(key) is None
+    assert calls == [], "fetch ran without VFT_FETCH_WEIGHTS=1"
+
+
+def test_fetch_verifies_and_caches(fake_upstream, monkeypatch, tmp_path):
+    key, calls = fake_upstream
+    monkeypatch.setenv("VFT_FETCH_WEIGHTS", "1")
+    p = store.find_checkpoint(key)
+    assert p is not None and p.read_bytes() == PAYLOAD
+    assert len(calls) == 1
+    assert not list(tmp_path.glob("*.part")), "temp file left behind"
+    # second resolve hits the cached file, no second download
+    assert store.find_checkpoint(key) == p
+    assert len(calls) == 1
+
+
+def test_fetch_refuses_digest_mismatch(fake_upstream, monkeypatch, tmp_path):
+    key, _ = fake_upstream
+    monkeypatch.setitem(store.CLIP_SHA256, "fake-model.pt", "0" * 64)
+    monkeypatch.setenv("VFT_FETCH_WEIGHTS", "1")
+    with pytest.raises(RuntimeError, match="does not match the published"):
+        store.find_checkpoint(key)
+    assert not list(tmp_path.iterdir()), (
+        "a digest-mismatched download must not leave any file behind")
+
+
+def test_fetch_prefix_digest(fake_upstream, monkeypatch, tmp_path):
+    """torch-hub style name-<8hex>.pth filenames verify against the
+    embedded prefix."""
+    key, _ = fake_upstream
+    fname = f"fake-{PAYLOAD_SHA[:8]}.pth"
+    monkeypatch.setitem(store.HUB_FILENAMES, key, (fname,))
+    monkeypatch.setitem(store.WEIGHT_URLS, fname,
+                        "https://example.invalid/" + fname)
+    monkeypatch.setenv("VFT_FETCH_WEIGHTS", "1")
+    p = store.find_checkpoint(key)
+    assert p is not None and p.name == fname
+
+
+def test_expected_digest_kinds():
+    assert store.expected_digest("ViT-B-32.pt")[0] == "sha256"
+    assert store.expected_digest("resnet18-f37072fd.pth") == (
+        "sha256-prefix", "f37072fd")
+    assert store.expected_digest("raft-sintel.pth") == (None, None)
+    assert store.expected_digest("i3d_rgb.pt") == (None, None)
+
+
+def test_every_hub_filename_has_a_url_or_is_alt():
+    """Each model key's PRIMARY upstream filename carries a URL (the
+    downloader tries filenames in order); alternates may be cache-only."""
+    for key, fnames in store.HUB_FILENAMES.items():
+        assert any(f in store.WEIGHT_URLS for f in fnames), (
+            f"{key}: no downloadable source filename")
+
+
+def test_require_value_tier_fails_loudly_without_weights(tmp_path):
+    """VFT_REQUIRE_VALUE_TIER=resnet makes the golden resnet variant FAIL
+    (not silently shape-tier) when no checkpoints resolve."""
+    import os
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               VFT_WEIGHTS_DIR=str(tmp_path),  # guaranteed empty
+               VFT_REQUIRE_VALUE_TIER="resnet")
+    env.pop("TORCH_HOME", None)
+    env["TORCH_HOME"] = str(tmp_path / "th")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_golden.py",
+         "-q", "-k", "resnet", "--no-header", "-x"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    joined = proc.stdout + proc.stderr
+    if "no committed golden refs" in joined or "sample video absent" in joined:
+        pytest.skip("golden refs not mounted")
+    assert proc.returncode != 0, (
+        "required family silently downgraded to shape tier:\n" + joined)
+    assert "silently downgraded" in joined

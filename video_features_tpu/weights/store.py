@@ -12,7 +12,12 @@ story is:
      previously, or ``{model_key}.pt[h]`` torch blobs dropped there;
   3. the torch hub cache (``$TORCH_HOME/hub/checkpoints``) for known
      torchvision/hub filenames;
-  4. random initialization — only if ``allow_random_weights`` is set (tests,
+  4. on a NETWORKED host, ``VFT_FETCH_WEIGHTS=1`` enables an in-process
+     download from the same upstream sources the reference uses (OpenAI CDN
+     with full SHA-256 pinning, reference models/clip/clip_src/clip.py:32-74;
+     torchvggish GitHub releases, vggish_slim.py:122-127; torch-hub /
+     torchvision CDN, extract_r21d.py:105-113), refusing on digest mismatch;
+  5. random initialization — only if ``allow_random_weights`` is set (tests,
      dry runs, benchmarks that only measure throughput).
 """
 from __future__ import annotations
@@ -55,6 +60,136 @@ HUB_FILENAMES: Dict[str, tuple] = {
     "clip_ViT-L-14-336px": ("ViT-L-14-336px.pt",),
 }
 
+#: full published SHA-256 digests: the OpenAI CDN embeds them in the
+#: download URL path and the reference's _download() verifies exactly this
+#: digest (reference models/clip/clip_src/clip.py:32-42,61-73)
+CLIP_SHA256: Dict[str, str] = {
+    "RN50.pt": "afeb0e10f9e5a86da6080e35cf09123aca3b358a0c3e3b6c78a7b63bc04b6762",
+    "RN101.pt": "8fa8567bab74a42d41c5915025a8e4538c3bdbe8804a470a72f30b0d94fab599",
+    "RN50x4.pt": "7e526bd135e493cef0776de27d5f42653e6b4c8bf9e0f653bb11773263205fdd",
+    "RN50x16.pt": "52378b407f34354e150460fe41077663dd5b39c54cd0bfd2b27167a4a06ec9aa",
+    "RN50x64.pt": "be1cfb55d75a9666199fb2206c106743da0f6468c9d327f3e0d0a543a9919d9c",
+    "ViT-B-32.pt": "40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af",
+    "ViT-B-16.pt": "5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f",
+    "ViT-L-14.pt": "b8cca3fd41ae0c99ba7e8951adf17d267cdb84cd88be6f7c2e0eca1737a03836",
+    "ViT-L-14-336px.pt": "3035c92b350959924f9f00213499208652fc7ea050643e8b385c2dac08641f02",
+}
+
+_TORCH_CDN = "https://download.pytorch.org/models/"
+_IG65M = "https://github.com/moabitcoin/ig65m-pytorch/releases/download/v1.0.0/"
+_VGGISH = "https://github.com/harritaylor/torchvggish/releases/download/v0.1/"
+#: the reference vendors these blobs inside its own git tree
+#: (.MISSING_LARGE_BLOBS); raw-file URLs are the only public source
+_REF_RAW = "https://github.com/habakan/video_features/raw/master/"
+
+#: upstream URL per filename — the same sources the reference downloads
+#: from (or, for repo-local blobs, vendors)
+WEIGHT_URLS: Dict[str, str] = {
+    **{f: _TORCH_CDN + f for key in ("resnet18", "resnet34", "resnet50",
+                                     "resnet101", "resnet152",
+                                     "r2plus1d_18_16_kinetics")
+       for f in HUB_FILENAMES[key]},
+    **{f: _IG65M + f for key in ("r2plus1d_34_32_ig65m_ft_kinetics",
+                                 "r2plus1d_34_8_ig65m_ft_kinetics")
+       for f in HUB_FILENAMES[key]},
+    "vggish-10086976.pth": _VGGISH + "vggish-10086976.pth",
+    "vggish_pca_params-970ea276.pth": _VGGISH + "vggish_pca_params-970ea276.pth",
+    **{f: f"https://openaipublic.azureedge.net/clip/models/{sha}/{f}"
+       for f, sha in CLIP_SHA256.items()},
+    "raft-sintel.pth": _REF_RAW + "models/raft/checkpoints/raft-sintel.pth",
+    "raft-kitti.pth": _REF_RAW + "models/raft/checkpoints/raft-kitti.pth",
+    "i3d_rgb.pt": _REF_RAW + "models/i3d/checkpoints/i3d_rgb.pt",
+    "i3d_flow.pt": _REF_RAW + "models/i3d/checkpoints/i3d_flow.pt",
+    "S3D_kinetics400_torchified.pt":
+        _REF_RAW + "models/s3d/checkpoint/S3D_kinetics400_torchified.pt",
+    "pwc_net_sintel.pt": _REF_RAW + "models/pwc/checkpoints/pwc_net_sintel.pt",
+}
+
+
+def expected_digest(fname: str):
+    """``(kind, digest)`` for an upstream filename: ``'sha256'`` (full,
+    CLIP CDN), ``'sha256-prefix'`` (the 8-hex tail torch-hub release names
+    embed, e.g. ``resnet18-f37072fd.pth``), or ``(None, None)`` for the
+    reference's repo-local blobs, which publish no digest."""
+    if fname in CLIP_SHA256:
+        return "sha256", CLIP_SHA256[fname]
+    stem = Path(fname).stem
+    if "-" in stem:
+        tail = stem.rsplit("-", 1)[1]
+        if len(tail) == 8 and all(c in "0123456789abcdef" for c in tail):
+            return "sha256-prefix", tail
+    return None, None
+
+
+def fetch_checkpoint(model_key: str) -> Optional[Path]:
+    """Download ``model_key``'s upstream checkpoint into ``weights_dir()``,
+    verifying the published SHA-256 while streaming. Mirrors the
+    reference's behavior (clip.py:61-73): a digest mismatch deletes the
+    file and raises — a truncated or tampered download is never usable.
+    Files with no published digest (the reference's repo-local blobs)
+    download with a provenance warning, matching the trust level of the
+    reference's own git-hosted copies.
+
+    Only called when ``VFT_FETCH_WEIGHTS=1`` (find_checkpoint); offline
+    behavior is unchanged without the flag.
+    """
+    import hashlib
+    import urllib.request
+    wd = weights_dir()
+    for fname in HUB_FILENAMES.get(model_key, ()):
+        url = WEIGHT_URLS.get(fname)
+        if url is None:
+            continue
+        dest = wd / fname
+        kind, digest = expected_digest(fname)
+        if kind is None:
+            print(f"WARNING: no published digest for {fname}; downloading "
+                  f"unverified from {url}")
+        wd.mkdir(parents=True, exist_ok=True)
+        # per-process unique temp name: concurrent fetchers sharing a
+        # weights dir (multi-host launch) must never interleave writes
+        # into one .part file and promote a co-written blob
+        import tempfile
+        fd, part_name = tempfile.mkstemp(prefix=fname + ".", suffix=".part",
+                                         dir=wd)
+        part = Path(part_name)
+        h = hashlib.sha256()
+        try:
+            # socket-level timeout also bounds mid-stream read stalls — a
+            # blackholed route must fail the fetch, not hang the run
+            with urllib.request.urlopen(url, timeout=60) as src, \
+                    os.fdopen(fd, "wb") as out:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+                    out.write(chunk)
+        except OSError as e:  # URLError subclasses OSError
+            part.unlink(missing_ok=True)
+            raise RuntimeError(
+                f"VFT_FETCH_WEIGHTS=1: download of {url} failed ({e}). "
+                "On an offline host, unset the flag and drop the file into "
+                f"{wd} instead.") from e
+        except Exception:
+            part.unlink(missing_ok=True)
+            raise
+        got = h.hexdigest()
+        ok = (kind is None or
+              (kind == "sha256" and got == digest) or
+              (kind == "sha256-prefix" and got.startswith(digest)))
+        if not ok:
+            part.unlink(missing_ok=True)
+            raise RuntimeError(
+                f"{fname}: downloaded file's SHA-256 {got[:16]}... does not "
+                f"match the published digest ({kind}:{digest}); refusing "
+                "to use it")
+        os.replace(part, dest)  # atomic: never a torn final file
+        print(f"fetched {fname} -> {dest}"
+              + (f" [{kind} verified]" if kind else " [UNVERIFIED]"))
+        return dest
+    return None
+
 
 def weights_dir() -> Path:
     return Path(os.environ.get(
@@ -82,6 +217,8 @@ def find_checkpoint(model_key: str,
         for p in (torch_home / "hub" / "checkpoints" / fname, wd / fname):
             if p.exists():
                 return p
+    if os.environ.get("VFT_FETCH_WEIGHTS") == "1":
+        return fetch_checkpoint(model_key)
     return None
 
 

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Measure the feature delta between the two fps decode paths.
+
+``fps_mode=select`` (default) feeds bit-exact source frames;
+``fps_mode=reencode`` reproduces the reference's provenance: decode a lossy
+re-encoded temp file (reference utils/io.py:14-36). The committed golden
+refs were computed from re-encoded pixels, so the VALUE tier's tolerance
+for fps-resampled variants must absorb this pixel difference — this script
+puts a measured number on it (VERDICT r4 missing #2), with random weights
+(the delta is a property of the input pixels and the network's Lipschitz
+behavior, not of the particular weights; run again with real weights when
+they arrive for the final word).
+
+Backend note: with no ffmpeg binary (this host), the re-encode goes
+through cv2's mp4v encoder instead of x264 — a different lossy codec with
+the same frame timing. The measured delta is therefore a same-order proxy
+for the x264 one, not its exact value.
+
+Usage: JAX_PLATFORMS=cpu python scripts/measure_fps_mode_delta.py
+Prints one JSON line per family plus a summary.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SAMPLE = "/root/reference/sample/v_GGSY1Qvo990.mp4"
+
+FAMILIES = {
+    # family -> (dotlist extras, feature key)
+    "resnet": (["model_name=resnet18", "batch_size=16"], "resnet"),
+    "r21d": (["model_name=r2plus1d_18_16_kinetics", "stack_size=10",
+              "step_size=10"], "r21d"),
+}
+
+
+def extract(family: str, extras, fps_mode: str, tmp_root: Path):
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+    dotlist = [f"feature_type={family}", "device=cpu", "extraction_fps=2",
+               "allow_random_weights=true", f"fps_mode={fps_mode}",
+               f"output_path={tmp_root / fps_mode / 'o'}",
+               f"tmp_path={tmp_root / fps_mode / 't'}",
+               f"video_paths={SAMPLE}"] + extras
+    args = load_config(family, parse_dotlist(dotlist))
+    sanity_check(args)
+    return get_extractor_cls(family)(args).extract(SAMPLE)
+
+
+def main() -> None:
+    import tempfile
+    sample = SAMPLE if Path(SAMPLE).exists() else None
+    if sample is None:
+        sys.exit("reference sample video not mounted")
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        for family, (extras, key) in FAMILIES.items():
+            sel = extract(family, extras, "select", Path(td) / family)
+            ren = extract(family, extras, "reencode", Path(td) / family)
+            a = np.asarray(sel[key], dtype=np.float64)
+            b = np.asarray(ren[key], dtype=np.float64)
+            assert a.shape == b.shape, (family, a.shape, b.shape)
+            if "timestamps_ms" in sel:  # clip-stack families emit none
+                np.testing.assert_array_equal(sel["timestamps_ms"],
+                                              ren["timestamps_ms"])
+            d = np.abs(a - b)
+            denom = np.abs(a) + np.abs(b) + 1e-9
+            cos = np.sum(a * b, axis=-1) / (
+                np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)
+                + 1e-12)
+            row = {
+                "family": family,
+                "feature_shape": list(a.shape),
+                "feature_scale_rms": float(np.sqrt(np.mean(a ** 2))),
+                "abs_delta_max": float(d.max()),
+                "abs_delta_mean": float(d.mean()),
+                "rel_delta_p99": float(np.quantile(2 * d / denom, 0.99)),
+                "cosine_min": float(cos.min()),
+                "backend": "cv2-mp4v (no ffmpeg on host)",
+            }
+            rows.append(row)
+            print(json.dumps(row))
+    worst = max(rows, key=lambda r: r["abs_delta_max"] /
+                max(r["feature_scale_rms"], 1e-9))
+    print(f"\nsummary: worst family {worst['family']}: max |delta| "
+          f"{worst['abs_delta_max']:.4g} on feature RMS "
+          f"{worst['feature_scale_rms']:.4g} "
+          f"(min cosine {worst['cosine_min']:.5f}). The golden value-tier "
+          "tolerance (atol=1e-2, rtol=1e-3, test_golden.py) must absorb "
+          "this when comparing select-mode features against refs computed "
+          "from re-encoded pixels — use fps_mode=reencode for those runs "
+          "instead.")
+
+
+if __name__ == "__main__":
+    main()

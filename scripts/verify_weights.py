@@ -226,8 +226,11 @@ def main() -> None:
         env = dict(os.environ, VFT_WEIGHTS_DIR=str(directory),
                    JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
         proc = subprocess.run(
+            # -rsf: the 'f' makes pytest print one "FAILED <id>" line per
+            # red test in the short summary — the per-family pass/fail
+            # parse below depends on those lines existing
             [sys.executable, "-m", "pytest", "tests/test_golden.py",
-             "-q", "-rs", "-s"],
+             "-q", "-rsf", "-s"],
             cwd=str(Path(__file__).resolve().parent.parent), env=env,
             capture_output=True, text=True)
         rc = proc.returncode

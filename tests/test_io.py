@@ -1,4 +1,6 @@
 """VideoSource: batching, overlap, fps resampling, timestamp contract."""
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -299,3 +301,85 @@ def test_process_video_source_killed_worker_raises(sample_video):
     with _pytest.raises(RuntimeError, match="died without a result"):
         for _ in it:  # drain whatever was queued, then hit the dead worker
             pass
+
+
+# ------------------------------------------------------- fps_mode=reencode
+
+
+def test_reencode_mode_same_frame_timing(sample_video, tmp_path):
+    """reencode (cv2 backend here; ffmpeg absent) must deliver the same
+    frame COUNT and timestamps as select-mode — only pixel provenance
+    differs (lossy codec). The timing rule is fps_filter_map on both
+    paths."""
+    from video_features_tpu.utils.io import VideoSource
+    sel = VideoSource(sample_video, batch_size=4, fps=2.0)
+    ren = VideoSource(sample_video, batch_size=4, fps=2.0,
+                      fps_mode="reencode", tmp_path=str(tmp_path))
+    sel_items = [(ts, idx) for _, ts, idx in sel.frames()]
+    ren_items = [(ts, idx) for _, ts, idx in ren.frames()]
+    assert len(sel_items) == len(ren_items) == sel.num_frames
+    np.testing.assert_allclose([t for t, _ in sel_items],
+                               [t for t, _ in ren_items], rtol=1e-9)
+    assert ren.fps == pytest.approx(2.0)
+
+
+def test_reencode_pixels_are_lossy_but_close(sample_video, tmp_path):
+    """The re-encoded stream's pixels must be (a) different from the
+    bit-exact select path (it IS a lossy generation) and (b) close to it
+    (same underlying frames). Guards against off-by-one frame selection
+    masquerading as codec noise."""
+    from video_features_tpu.utils.io import VideoSource
+    sel = [f for f, _, _ in VideoSource(sample_video, fps=2.0).frames()]
+    ren = [f for f, _, _ in VideoSource(
+        sample_video, fps=2.0, fps_mode="reencode",
+        tmp_path=str(tmp_path)).frames()]
+    assert len(sel) == len(ren)
+    deltas = [np.abs(a.astype(np.int16) - b.astype(np.int16)).mean()
+              for a, b in zip(sel, ren)]
+    assert max(deltas) > 0, "reencode delivered bit-identical pixels — " \
+        "the lossy intermediate is not actually being decoded"
+    # a mis-selected frame pair in this synthetic/real clip differs by
+    # far more than codec quantization noise
+    assert np.mean(deltas) < 20.0, (
+        f"mean |delta| {np.mean(deltas):.1f} u8-steps: frame selection "
+        "diverged between the two modes, not just codec noise")
+
+
+def test_reencode_tmp_file_cleanup(sample_video, tmp_path):
+    from video_features_tpu.utils.io import VideoSource
+    src = VideoSource(sample_video, fps=2.0, fps_mode="reencode",
+                      tmp_path=str(tmp_path))
+    tmp_file = Path(src._tmp_file)
+    assert tmp_file.exists()
+    for _ in src.frames():
+        pass
+    assert not tmp_file.exists(), "temp file must be removed after decode"
+    keep = VideoSource(sample_video, fps=2.0, fps_mode="reencode",
+                       tmp_path=str(tmp_path), keep_tmp=True)
+    kept = Path(keep._tmp_file)
+    for _ in keep.frames():
+        pass
+    assert kept.exists(), "keep_tmp=True must preserve the temp file"
+
+
+def test_reencode_total_mode(sample_video, tmp_path):
+    """total + reencode: the reference derives fps from total and decodes
+    the re-encoded file capped at total frames (utils/io.py:83-89)."""
+    from video_features_tpu.utils.io import VideoSource
+    src = VideoSource(sample_video, total=9, fps_mode="reencode",
+                      tmp_path=str(tmp_path))
+    frames = list(src.frames())
+    assert len(frames) <= 9
+    assert len(frames) >= 8  # round(n*r) may fall one short of total
+
+
+def test_reencode_second_pass_raises(sample_video, tmp_path):
+    """cv2 fails silently on a missing path; a consumed single-pass
+    reencode source must raise, not yield an empty stream."""
+    from video_features_tpu.utils.io import VideoSource
+    src = VideoSource(sample_video, fps=2.0, fps_mode="reencode",
+                      tmp_path=str(tmp_path))
+    for _ in src.frames():
+        pass
+    with pytest.raises(RuntimeError, match="single-pass"):
+        next(src.frames())

@@ -234,6 +234,13 @@ def sanity_check(args: Config) -> None:
                     and args.get("extraction_total") is not None), \
             "`extraction_fps` and `extraction_total` are mutually exclusive"
 
+    fps_mode = args.get("fps_mode", "select") or "select"
+    if fps_mode not in ("select", "reencode"):
+        raise ValueError(
+            f"fps_mode={fps_mode!r}: expected 'select' (bit-exact source "
+            "frames, the default) or 'reencode' (the reference's lossy "
+            "temp-file decode path, for golden-parity runs)")
+
     # Namespace outputs under feature_type[/model_name], '/'->'_'
     # (reference utils/utils.py:112-125).
     subs: List[str] = [args.feature_type]

@@ -49,10 +49,16 @@ class BaseExtractor:
         self.args = args
 
     def video_source(self, video_path: str, **kwargs):
-        """Family-agnostic VideoSource factory honoring video_decode."""
+        """Family-agnostic VideoSource factory honoring video_decode and
+        fps_mode (``reencode`` = the reference's lossy temp-file decode
+        path for golden/parity runs, utils/io.py module docstring)."""
         from ..utils.io import ProcessVideoSource, VideoSource
         cls = (ProcessVideoSource if self.video_decode == "process"
                else VideoSource)
+        if self.args.get("fps_mode", "select") == "reencode":
+            kwargs.setdefault("fps_mode", "reencode")
+            kwargs.setdefault("tmp_path", self.args.get("tmp_path", "tmp"))
+            kwargs.setdefault("keep_tmp", self.keep_tmp_files)
         return cls(video_path, **kwargs)
 
     def _data_mesh(self):

@@ -17,11 +17,21 @@ Re-design of the reference's `VideoLoader` + ffmpeg re-encoding
 
 Deliberate divergence: the reference shells out to
 ``ffmpeg -filter:v fps=N`` writing a *re-encoded* (lossy x264) temp file and
-then decodes that (reference utils/io.py:14-36). Here resampling is pure frame
-selection/duplication on the decoded stream — the same frame-timing rule as
-ffmpeg's fps filter (round=near), but with bit-exact source pixels, no temp
-files, no subprocess, and no double decode. This is strictly more accurate and
-keeps the single host core free to feed the TPU.
+then decodes that (reference utils/io.py:14-36). Here the DEFAULT
+(``fps_mode='select'``) is pure frame selection/duplication on the decoded
+stream — the same frame-timing rule as ffmpeg's fps filter (round=near), but
+with bit-exact source pixels, no temp files, no subprocess, and no double
+decode. This is strictly more accurate and keeps the single host core free to
+feed the TPU.
+
+``fps_mode='reencode'`` opts back into the reference's exact provenance for
+golden/parity runs: the committed golden refs were computed from *re-encoded*
+pixels, so value-level comparison of fps-resampled variants must decode the
+same lossy intermediate (VERDICT r4 missing #2). With an ffmpeg binary on
+PATH it reproduces the reference command byte for byte; otherwise a cv2
+``VideoWriter`` (mp4v) fallback writes the same frame selection through a
+lossy codec so the decode-path feature delta stays measurable on
+ffmpeg-less hosts (docs/performance.md records the measured numbers).
 """
 from __future__ import annotations
 
@@ -112,6 +122,72 @@ def fps_filter_map(num_frames: int, src_fps: float, dst_fps: float) -> np.ndarra
     return mapping
 
 
+def reencode_video_with_diff_fps(video_path: Union[str, Path],
+                                 tmp_path: Union[str, Path],
+                                 extraction_fps: float,
+                                 backend: str = "auto") -> str:
+    """Write a lossy re-encoded copy of ``video_path`` resampled to
+    ``extraction_fps`` into ``tmp_path``; return its path.
+
+    ``backend='ffmpeg'`` reproduces the reference's command exactly
+    (``ffmpeg -hide_banner -loglevel panic -y -i <in> -filter:v
+    fps=fps=<fps> <out>``, reference utils/io.py:14-36) including the
+    ``{stem}_new_fps.mp4`` temp naming. ``backend='cv2'`` decodes the
+    source, applies the SAME frame selection (fps_filter_map — verified
+    against the real filter) and writes through cv2's mp4v encoder: the
+    frame timing is identical, the pixels go through a different lossy
+    codec (MPEG-4 pt.2 vs x264). ``'auto'`` prefers ffmpeg when on PATH.
+    """
+    import shutil as _shutil
+    video_path, tmp_path = str(video_path), str(tmp_path)
+    if backend == "auto":
+        backend = "ffmpeg" if _shutil.which("ffmpeg") else "cv2"
+    Path(tmp_path).mkdir(parents=True, exist_ok=True)
+    new_path = str(Path(tmp_path) / f"{Path(video_path).stem}_new_fps.mp4")
+
+    if backend == "ffmpeg":
+        import subprocess
+        cmd = [_shutil.which("ffmpeg"), "-hide_banner", "-loglevel",
+               "panic", "-y", "-i", video_path,
+               "-filter:v", f"fps=fps={extraction_fps}", new_path]
+        subprocess.run(cmd, check=True)
+        return new_path
+    if backend != "cv2":
+        raise ValueError(f"unknown reencode backend {backend!r}")
+
+    props = get_video_props(video_path)
+    n = props["num_frames"]
+    if n <= 0:
+        n = count_frames_by_decode(video_path)
+        if n == 0:
+            raise ValueError(f"No decodable frames in {video_path}")
+    mapping = fps_filter_map(n, props["fps"], float(extraction_fps))
+    writer = cv2.VideoWriter(
+        new_path, cv2.VideoWriter_fourcc(*"mp4v"), float(extraction_fps),
+        (props["width"], props["height"]))
+    if not writer.isOpened():
+        raise RuntimeError(
+            f"cv2 VideoWriter cannot open {new_path} (mp4v); install "
+            "ffmpeg for fps_mode=reencode on this host")
+    stream = _FrameStream(video_path, channel_order="bgr")
+    try:
+        src_idx = -1
+        current = None
+        for want in mapping:
+            while src_idx < want:
+                current = stream.read()
+                if current is None:
+                    break
+                src_idx += 1
+            if current is None:
+                break
+            writer.write(current)
+    finally:
+        stream.release()
+        writer.release()
+    return new_path
+
+
 class _FrameStream:
     """Sequential decoder with the missing-frame-0 workaround.
 
@@ -178,7 +254,10 @@ class VideoSource:
                  total: Optional[int] = None,
                  transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
                  overlap: int = 0,
-                 channel_order: str = "rgb"):
+                 channel_order: str = "rgb",
+                 fps_mode: str = "select",
+                 tmp_path: Optional[Union[str, Path]] = None,
+                 keep_tmp: bool = False):
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
         # eager: _FrameStream re-checks lazily at first decode, but that
@@ -187,12 +266,36 @@ class VideoSource:
         assert channel_order in ("rgb", "bgr"), channel_order
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
+        if fps_mode not in ("select", "reencode"):
+            raise ValueError(
+                f"fps_mode={fps_mode!r}: expected 'select' or 'reencode'")
         self.path = str(path)
         self.batch_size = batch_size
         self.transform = transform
         self.overlap = overlap
         #: 'bgr' defers the RGB reorder into the transform (see _FrameStream)
         self.channel_order = channel_order
+
+        self._tmp_file: Optional[str] = None
+        self._keep_tmp = keep_tmp
+        self._total_cap: Optional[int] = None
+        if fps_mode == "reencode" and (fps is not None or total is not None):
+            # reference-provenance path: decode a lossy re-encoded temp
+            # file at the target rate (reference utils/io.py:75-89 does
+            # this for BOTH fps and total) and iterate it natively
+            if tmp_path is None:
+                raise ValueError("fps_mode='reencode' requires tmp_path")
+            src_props = get_video_props(self.path)
+            n0 = src_props["num_frames"]
+            if total is not None and n0 <= 0:
+                n0 = count_frames_by_decode(self.path)
+            eff_fps = (fps if fps is not None
+                       else total * src_props["fps"] / max(n0, 1))
+            self._tmp_file = reencode_video_with_diff_fps(
+                self.path, tmp_path, eff_fps)
+            self.path = self._tmp_file
+            self._total_cap = total
+            fps = total = None
 
         props = get_video_props(self.path)
         self.src_fps = props["fps"]
@@ -219,9 +322,23 @@ class VideoSource:
             self.fps = self.src_fps
             self.index_map = None
             self.num_frames = self.src_num_frames
+            if self._total_cap is not None:
+                # reencode+total: the reference stops at len(self)==total
+                # or stream end, whichever first (utils/io.py:117-119)
+                self.num_frames = min(self.num_frames, self._total_cap) \
+                    if self.num_frames > 0 else self._total_cap
 
     def __len__(self):
         return self.num_frames
+
+    def _cleanup_tmp(self) -> None:
+        tmp, self._tmp_file = self._tmp_file, None
+        if tmp and not self._keep_tmp:
+            self._tmp_deleted = True
+            try:
+                Path(tmp).unlink(missing_ok=True)
+            except OSError:
+                pass
 
     def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
         """Yield (frame, timestamp_ms, out_index) sequentially.
@@ -231,6 +348,14 @@ class VideoSource:
         resize/crop would silently be skipped for one of them.
         """
         from .profiling import profiler
+        if getattr(self, "_tmp_deleted", False):
+            # cv2 on a missing path fails SILENTLY (read() -> None): a
+            # second pass over a consumed reencode-mode source would yield
+            # an empty stream, not an error — fail loudly instead
+            raise RuntimeError(
+                f"reencode-mode VideoSource for {self.path} is single-"
+                "pass: its re-encoded temp file was already deleted "
+                "(construct a new source, or pass keep_tmp=True)")
         stream = _FrameStream(self.path, self.channel_order)
         tf = self.transform
 
@@ -246,7 +371,7 @@ class VideoSource:
         try:
             if self.index_map is None:
                 out_idx = 0
-                while True:
+                while self._total_cap is None or out_idx < self._total_cap:
                     rgb = timed_read()
                     if rgb is None:
                         return
@@ -281,9 +406,16 @@ class VideoSource:
                     yield emit(current, out_idx)
         finally:
             stream.release()
+            self._cleanup_tmp()
 
     def __iter__(self) -> Iterator[Tuple[List, List[float], List[int]]]:
         return _batched(self.frames(), self.batch_size, self.overlap)
+
+    def __del__(self):  # abandoned before/inside iteration: drop the
+        try:            # re-encoded temp file (reference utils/io.py:160-164)
+            self._cleanup_tmp()
+        except Exception:
+            pass
 
 
 def _batched(frames: Iterator[Tuple[np.ndarray, float, int]],
@@ -362,7 +494,9 @@ class ProcessVideoSource:
                  fps: Optional[float] = None, total: Optional[int] = None,
                  transform: Optional[Callable] = None, overlap: int = 0,
                  channel_order: str = "rgb", depth: int = 16,
-                 start_timeout_s: float = 120.0):
+                 start_timeout_s: float = 120.0, fps_mode: str = "select",
+                 tmp_path: Optional[Union[str, Path]] = None,
+                 keep_tmp: bool = False):
         import multiprocessing as mp
         self.path = str(path)
         self.batch_size = batch_size
@@ -374,7 +508,9 @@ class ProcessVideoSource:
             args=(self._q, self.path,
                   dict(batch_size=1, fps=fps, total=total,
                        transform=transform, overlap=0,
-                       channel_order=channel_order)),
+                       channel_order=channel_order, fps_mode=fps_mode,
+                       tmp_path=None if tmp_path is None else str(tmp_path),
+                       keep_tmp=keep_tmp)),
             daemon=True)
         self._proc.start()
         try:

@@ -154,11 +154,13 @@ def fetch_checkpoint(model_key: str) -> Optional[Path]:
                                          dir=wd)
         part = Path(part_name)
         h = hashlib.sha256()
+        # wrap the fd BEFORE touching the network: if urlopen raises, the
+        # with-statement still closes `out` (bare fd would leak per retry)
+        out = os.fdopen(fd, "wb")
         try:
             # socket-level timeout also bounds mid-stream read stalls — a
             # blackholed route must fail the fetch, not hang the run
-            with urllib.request.urlopen(url, timeout=60) as src, \
-                    os.fdopen(fd, "wb") as out:
+            with out, urllib.request.urlopen(url, timeout=60) as src:
                 while True:
                     chunk = src.read(1 << 20)
                     if not chunk:

@@ -383,3 +383,67 @@ def test_reencode_second_pass_raises(sample_video, tmp_path):
         pass
     with pytest.raises(RuntimeError, match="single-pass"):
         next(src.frames())
+
+
+# --------------------------------------------------- intra-video parallel
+
+
+@pytest.mark.parametrize("workers,fps,overlap", [(2, 2.0, 0), (4, None, 0),
+                                                 (3, 3.0, 1)])
+def test_parallel_decode_bit_equal_to_serial(sample_video, workers, fps,
+                                             overlap):
+    """N seek-aligned segment decoders must reproduce the serial stream
+    BIT-exactly — frames, timestamps, indices, batching, overlap."""
+    from video_features_tpu.ops.host_transforms import ResizeCropTransform
+    from video_features_tpu.utils.io import ParallelVideoSource, VideoSource
+    kw = dict(batch_size=7, fps=fps, overlap=overlap,
+              transform=ResizeCropTransform(80, 64, "bilinear", "uint8"))
+    serial = list(VideoSource(sample_video, **kw))
+    par = list(ParallelVideoSource(sample_video, decode_workers=workers,
+                                   **kw))
+    assert len(serial) == len(par)
+    for (b1, t1, i1), (b2, t2, i2) in zip(serial, par):
+        assert t1 == t2 and i1 == i2
+        for f1, f2 in zip(b1, b2):
+            np.testing.assert_array_equal(f1, f2)
+
+
+def test_parallel_decode_corrupt_video_raises(tmp_path):
+    from video_features_tpu.utils.io import ParallelVideoSource
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"junk" * 200)
+    with pytest.raises(ValueError):
+        ParallelVideoSource(str(bad), fps=2.0, decode_workers=2)
+
+
+def test_parallel_decode_rejects_reencode(sample_video, tmp_path):
+    from video_features_tpu.utils.io import ParallelVideoSource
+    with pytest.raises(NotImplementedError, match="fps_mode=select"):
+        ParallelVideoSource(sample_video, fps=2.0, decode_workers=2,
+                            fps_mode="reencode", tmp_path=str(tmp_path))
+
+
+def test_parallel_decode_through_extractor(sample_video, tmp_path,
+                                           monkeypatch):
+    """video_decode=parallel end to end (resnet): features identical to
+    the inline decode path — the factory wiring in extractors/base.py."""
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "w"))
+
+    def feats(decode, extra=()):
+        args = load_config("resnet", parse_dotlist([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_fps=2", "allow_random_weights=true",
+            f"video_decode={decode}", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}",
+            f"video_paths={sample_video}", *extra]))
+        sanity_check(args)
+        return get_extractor_cls("resnet")(args).extract(sample_video)
+
+    inline = feats("inline")
+    par = feats("parallel", ("decode_workers=3",))
+    np.testing.assert_array_equal(inline["timestamps_ms"],
+                                  par["timestamps_ms"])
+    np.testing.assert_array_equal(inline["resnet"], par["resnet"])

@@ -42,19 +42,36 @@ class BaseExtractor:
         # hosts. Default 'inline' (decode on the calling/video_workers
         # thread).
         self.video_decode = args.get("video_decode") or "inline"
-        if self.video_decode not in ("inline", "process"):
+        if self.video_decode not in ("inline", "process", "parallel"):
             raise NotImplementedError(
-                f"video_decode={self.video_decode!r}: expected 'inline' "
-                "or 'process'")
+                f"video_decode={self.video_decode!r}: expected 'inline', "
+                "'process' or 'parallel'")
+        # decode_workers: intra-video parallel decode width for
+        # video_decode=parallel (utils/io.py ParallelVideoSource)
+        raw_dw = args.get("decode_workers")
+        self.decode_workers = 2 if raw_dw is None else int(raw_dw)
+        if self.decode_workers < 1:
+            raise ValueError(
+                f"decode_workers={self.decode_workers}: need >= 1")
+        # decode_depth: per-worker frame-queue cap (None -> full segment
+        # for transformed streams, 64 for raw-frame streams)
+        raw_dd = args.get("decode_depth")
+        self.decode_depth = None if raw_dd is None else int(raw_dd)
         self.args = args
 
     def video_source(self, video_path: str, **kwargs):
         """Family-agnostic VideoSource factory honoring video_decode and
         fps_mode (``reencode`` = the reference's lossy temp-file decode
         path for golden/parity runs, utils/io.py module docstring)."""
-        from ..utils.io import ProcessVideoSource, VideoSource
-        cls = (ProcessVideoSource if self.video_decode == "process"
-               else VideoSource)
+        from ..utils.io import (ParallelVideoSource, ProcessVideoSource,
+                                VideoSource)
+        cls = {"process": ProcessVideoSource,
+               "parallel": ParallelVideoSource}.get(self.video_decode,
+                                                    VideoSource)
+        if cls is ParallelVideoSource:
+            kwargs.setdefault("decode_workers", self.decode_workers)
+            if self.decode_depth is not None:
+                kwargs.setdefault("depth", self.decode_depth)
         if self.args.get("fps_mode", "select") == "reencode":
             kwargs.setdefault("fps_mode", "reencode")
             kwargs.setdefault("tmp_path", self.args.get("tmp_path", "tmp"))

@@ -240,6 +240,56 @@ def bench_i3d_ours(stack: int = I3D_STACK, iters: int = 10,
     return _device_rate(step, args, n_stacks, iters, warmup)
 
 
+def bench_i3d_pwc_ours(stack: int = I3D_STACK, iters: int = 10,
+                       warmup: int = 3, n_stacks: int = 4) -> float:
+    """I3D RGB+Flow(PWC) stacks/sec — the DEFAULT i3d configuration
+    (configs/i3d.yml flow_type: pwc, matching the reference default) in
+    its production bf16 mode (models/pwc.py PWCNet.dtype: conv stacks and
+    cost volumes bf16; flow tensors, warp grid and flow heads f32 — drift
+    0.015 px max, an order under the flow stream's ToUInt8 quantization).
+
+    Round-5 interleaved A/B (scripts/bench_i3d_variants.py, medians of 4
+    alternating rounds on v5e): raft-s4f 6.28 / pwc-f32 5.86 / pwc-bf16
+    6.78 / x2 stacks 11.33 / x4 stacks 12.08 / x8 10.90 stacks/s — so
+    n_stacks=4 (what _pwc_stacks_per_forward auto-picks at this geometry)
+    and the default flow_type stays pwc, now measured rather than
+    inherited."""
+    import jax
+    import jax.numpy as jnp
+    _enable_cache_off_cpu()
+    from video_features_tpu.extractors.i3d import _i3d_forward
+    from video_features_tpu.extractors.i3d_flow import _crop_quantize
+    from video_features_tpu.models import i3d as i3d_m, pwc as pwc_m
+    from video_features_tpu.parallel.mesh import cast_floating
+
+    model = i3d_m.I3D(num_classes=400)
+    pwc = pwc_m.PWCNet(dtype=jnp.bfloat16)
+    i3d_rgb = cast_floating(i3d_m.init_params("rgb"), jnp.bfloat16)
+    i3d_flow = cast_floating(i3d_m.init_params("flow"), jnp.bfloat16)
+    pwc_p = pwc_m.init_params()
+
+    @jax.jit
+    def step(pp, pr, pf, stacks_u8):
+        s = stacks_u8.shape[0]
+        pairs = jnp.stack([stacks_u8[:, :-1], stacks_u8[:, 1:]], axis=2)
+        pairs = pairs.reshape((s * stack,) + pairs.shape[2:])
+        x = pairs.astype(jnp.float32)
+        flow = pwc.apply({"params": pp}, x[:, 0], x[:, 1])
+        quant = _crop_quantize(flow, I3D_SIDE)
+        quant = quant.reshape((s, stack) + quant.shape[1:])
+        rgb_feat = _i3d_forward(model, jnp.bfloat16, True, pr,
+                                stacks_u8[:, :-1].astype(jnp.float32))
+        flow_feat = _i3d_forward(model, jnp.bfloat16, True, pf, quant)
+        return rgb_feat, flow_feat
+
+    rng = np.random.default_rng(0)
+    stacks = [jax.device_put(rng.integers(
+        0, 255, size=(n_stacks, stack + 1, I3D_SIDE, I3D_SIDE, 3),
+        dtype=np.uint8)) for _ in range(2)]
+    args = [(pwc_p, i3d_rgb, i3d_flow, s) for s in stacks]
+    return _device_rate(step, args, n_stacks, iters, warmup)
+
+
 def bench_pipeline(n_copies: int = 8) -> dict:
     """Sustained REAL-pipeline throughput: decode -> transform -> device ->
     sink, through the actual CLI driver, on ``n_copies`` of the vendored
@@ -580,6 +630,12 @@ def main() -> None:
         print(f"WARNING: i3d bf16-raft bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
         i3d_bf = None
+    try:
+        i3d_pwc = bench_i3d_pwc_ours()
+    except Exception as e:
+        print(f"WARNING: i3d pwc bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        i3d_pwc = None
     i3d_torch = None
     if i3d is not None:
         try:
@@ -606,20 +662,30 @@ def main() -> None:
                 "in one process (scripts/bench_i3d_variants.py: round-3 "
                 "config 3.94 vs round-4 6.34 stacks/s, medians of 4 "
                 "alternating rounds); this row is the sequential re-run")
-    for label, value in (("bf16 i3d / f32 raft", i3d),
-                         ("bf16 i3d + bf16 raft", i3d_bf)):
+    pwc_note = ("round-5: the DEFAULT i3d config (flow_type=pwc, as in the "
+                "reference) finally measured AND optimized: bf16 PWC conv "
+                "stacks (models/pwc.py dtype; flow/warp math f32, 0.015 px "
+                "drift) + 4 stacks/forward. Interleaved A/B medians "
+                "(bench_i3d_variants.py): raft-s4f 6.28 / pwc-f32 5.86 / "
+                "pwc-bf16x4 12.08 stacks/s — pwc default is now measured, "
+                "not inherited")
+    for label, value, flow_kind, note in (
+            ("bf16 i3d / f32 raft", i3d, "raft", i3d_note),
+            ("bf16 i3d + bf16 raft", i3d_bf, "raft", i3d_note),
+            ("bf16 i3d + bf16 pwc, DEFAULT config", i3d_pwc, "pwc",
+             pwc_note)):
         if value is None:
             continue
         ratio = (value / i3d_torch
                  if i3d_torch and i3d_torch == i3d_torch else None)
         metrics.append({
-            "metric": f"i3d rgb+flow(raft) {I3D_STACK}f@{I3D_SIDE}px stack "
-                      f"throughput ({platform}, {label})",
+            "metric": f"i3d rgb+flow({flow_kind}) {I3D_STACK}f@{I3D_SIDE}px "
+                      f"stack throughput ({platform}, {label})",
             "value": round(value, 3),
             "unit": "stacks/sec/chip",
             "vs_baseline": round(ratio, 2) if ratio is not None else None,
             "baseline": BASELINE_DESC,
-            "note": i3d_note,
+            "note": note,
         })
 
     # ---- per-family rows (round-4: every family gets a number) ----------

@@ -15,8 +15,6 @@ import jax.numpy as jnp
 # accelerator plugin registration on this host and breaks backend discovery
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from video_features_tpu.kernels.cost_volume import (cost_volume_pallas,
-                                                    cost_volume_xla)
 from video_features_tpu.kernels.corr_lookup import (corr_lookup_onehot,
                                                     corr_lookup_pallas)
 from video_features_tpu.models.raft import build_corr_pyramid, corr_lookup
@@ -39,17 +37,6 @@ def timeit(fn, *args, iters=200):
 def main():
     print("platform:", jax.devices()[0])
     rng = np.random.default_rng(0)
-
-    print("\n-- PWC cost volume (B,H,W,C) --")
-    for shape in [(1, 112, 256, 32), (1, 56, 128, 64), (4, 28, 64, 96),
-                  (4, 7, 16, 196)]:
-        f1 = jnp.asarray(rng.normal(size=shape).astype(np.float32))
-        f2 = jnp.asarray(rng.normal(size=shape).astype(np.float32))
-        xla_fn = jax.jit(cost_volume_xla)
-        t_x = timeit(xla_fn, f1, f2)
-        t_p = timeit(lambda a, b: cost_volume_pallas(a, b), f1, f2)
-        print(f"{shape}: xla {t_x:.3f} ms  pallas {t_p:.3f} ms  "
-              f"speedup {t_x / t_p:.2f}x")
 
     print("\n-- RAFT corr lookup (B, H8, W8) --")
     for b, h8, w8 in [(1, 46, 46), (4, 46, 46), (8, 28, 28)]:

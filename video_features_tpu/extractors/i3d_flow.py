@@ -106,10 +106,35 @@ def _stacks_per_forward(t: int, h: int, w: int, cap: int = 4) -> int:
     return k
 
 
+def _pwc_stacks_per_forward(t: int, h: int, w: int, cap: int = 4,
+                            bytes_per_el: int = 2) -> int:
+    """PWC twin of :func:`_stacks_per_forward`.
+
+    PWC's dominant live set is not an all-pairs pyramid but the per-pair
+    decoder activations: two extractor pyramids (~15·HpWp elements/pair
+    summed over levels) plus the /4-resolution DenseNet concat stack
+    (peak ~565 channels -> ~35·HpWp) and smaller coarse levels (~20·HpWp),
+    ≈ 70·Hp·Wp elements/pair — ~9 MB/pair bf16 at 256x256 (validated:
+    256 pairs = 2.3 GB ran clean on v5e in the round-5 A/B).
+    ``bytes_per_el`` is 2 under precision=bfloat16, 4 for f32 runs (the
+    default precision) — the caller passes the flow dtype's width.
+    Power-of-two k under the device-derived budget, same wire-bucket
+    rationale."""
+    hp, wp = -(-h // 64) * 64, -(-w // 64) * 64
+    per_pair = 70 * hp * wp * bytes_per_el
+    per_stack = t * per_pair
+    budget = _flow_pyramid_budget()
+    k = 1
+    while k * 2 <= cap and (k * 2) * per_stack <= budget:
+        k *= 2
+    return k
+
+
 class FlowStream:
 
     def __init__(self, parent, args, mesh, dtype, allow_random) -> None:
         self.parent = parent
+        self._flow_dtype = dtype  # sizes the PWC stack-batch HBM budget
         # stacks fused per flow forward: 'auto' (geometry-sized at dispatch,
         # see _stacks_per_forward) or a forced integer
         raw_sb = args.get("flow_stack_batch", "auto")
@@ -146,9 +171,13 @@ class FlowStream:
                 mesh=mesh, fixed_batch=parent.stack_size)
         elif parent.flow_type == "pwc":
             # PWC path: no padder — the net resizes to /64 internally and
-            # returns input-resolution flow (extract_i3d.py:154-155)
+            # returns input-resolution flow (extract_i3d.py:154-155).
+            # Under precision=bfloat16 the conv stacks run bf16 like RAFT's
+            # (models/pwc.py PWCNet.dtype; flow/warp math stays f32):
+            # measured drift 0.015 px max — an order of magnitude under
+            # the ToUInt8 quantization step this stream applies.
             from ..models import pwc as pwc_model
-            flow_model = pwc_model.PWCNet()
+            flow_model = pwc_model.PWCNet(dtype=dtype)
             flow_params = store.resolve_params(
                 "pwc_sintel", pwc_model.init_params,
                 pwc_model.params_from_torch,
@@ -221,11 +250,14 @@ class FlowStream:
         elif self.parent.flow_type == "raft":
             k = _stacks_per_forward(t, *group.shape[2:4])
         else:
-            # auto applies only where the HBM model is validated: the
-            # budget models RAFT's all-pairs pyramid, which PWC does not
-            # allocate. PWC keeps per-stack dispatch unless the user
-            # forces flow_stack_batch explicitly.
-            k = 1
+            # PWC budget models the decoder live set, not RAFT's all-pairs
+            # pyramid (_pwc_stacks_per_forward). Round-5 interleaved A/B
+            # at 64f@224px on v5e: 1 -> 2 stacks/forward took bf16 PWC
+            # from 6.78 to 11.33 stacks/s (scripts/bench_i3d_variants.py
+            # p1b/p2b medians).
+            k = _pwc_stacks_per_forward(
+                t, *group.shape[2:4],
+                bytes_per_el=jnp.dtype(self._flow_dtype).itemsize)
         outs = []
         for i in range(0, len(group), k):
             chunk = group[i:i + k]            # (kc, T+1, H, W, 3)

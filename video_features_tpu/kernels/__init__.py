@@ -1,25 +1,21 @@
-"""Pallas TPU kernels for the framework's hot ops.
+"""TPU kernels for the framework's hot ops.
 
 The reference ships exactly one native compute kernel — the PWC-Net
 correlation (cost volume) written in raw CUDA C and JIT-compiled through CuPy
 (reference models/pwc/pwc_src/correlation.py:47-115) — and does its other
 memory-bound hot loop, the RAFT correlation-pyramid lookup, as a
-grid_sample gather (reference models/raft/raft_src/corr.py:29-50). Here both
-are first-class TPU kernels:
+grid_sample gather (reference models/raft/raft_src/corr.py:29-50). Here:
 
-  - :mod:`cost_volume` — the 81-channel windowed cost volume as a Pallas
-    kernel (halo-DMA'd second feature map, channel-major VMEM tiles);
   - :mod:`corr_lookup` — the windowed bilinear pyramid lookup recast as
     one-hot matmul contractions (gather-free, rides the MXU), as a fused
-    Pallas kernel and a pure-XLA twin.
-
-Dispatch: the cost-volume wrapper takes ``impl`` = ``'pallas' | 'xla' |
-None``; ``None`` follows ``VFT_PALLAS`` (default: XLA everywhere — see
-:func:`pallas_enabled` for the hardware-fault rationale; interpret mode
-keeps the kernel testable on CPU). The corr lookup is selected separately
-by ``VFT_CORR_LOOKUP`` in models/raft.py — ``pallas`` (TPU default, the
-20x one) | ``onehot`` | ``gather`` (CPU default); both env vars are read
-at trace time, so set them before the first forward.
+    Pallas kernel and a pure-XLA twin. Selected by ``VFT_CORR_LOOKUP``
+    in models/raft.py — ``pallas`` (TPU default, the 20x one) |
+    ``onehot`` | ``gather`` (CPU default); read at trace time.
+  - :mod:`cost_volume` — the 81-channel windowed cost volume as the XLA
+    shifted-window formulation. A Pallas twin was built, hardware-
+    validated, measured TIED with XLA across every real PWC shape in f32
+    and bf16, and deleted in round 5 (measured negative result recorded
+    in that module's docstring).
 
 Measured on TPU v5e with a D2H-fenced timer (parallel/mesh.py settle;
 earlier microbenchmarks fenced with block_until_ready, which acks early
@@ -30,39 +26,10 @@ through dev-chip tunnels and reported pure dispatch latency — those
     gather 4,097 ms / one-hot 331 ms / fused Pallas 200 ms. The 81-tap
     4-corner scalar gathers are the worst access pattern the TPU has; the
     MXU contraction forms win by 12-20x, so Pallas is the TPU default.
-  cost volume (per call, fine levels): XLA 51 ms vs Pallas 45 ms at
-    (1,112,256,32); 15 vs 8 ms at (1,56,128,64) — Pallas modestly ahead
-    where it runs. But at un-128-aligned widths — PWC's coarse levels —
-    the Pallas kernel faults on real hardware (worker crash / Mosaic
-    compile error; interpret mode cannot catch it), so XLA is the default
-    and ``VFT_PALLAS=1`` is an explicit opt-in for aligned shapes.
 """
 from __future__ import annotations
 
-import os
-
 import jax
-
-
-def pallas_enabled() -> bool:
-    """Static (trace-time) switch for the COST-VOLUME pallas-vs-XLA dispatch
-    (the corr lookup has its own dispatcher in models/raft.py).
-
-    Defaults to False ON MEASUREMENT, not fear: after the round-2 lane
-    (W->128) and sublane (H->8) padding fixes, ``cost_volume_pallas`` is
-    hardware-validated CLEAN on every real PWC pyramid shape (15 shapes, 3
-    input geometries x 5 decoder levels, odd/tiny sizes included; parity
-    <3e-7 vs the XLA twin). Timed best-of-3 on v5e it is within noise of the
-    XLA formulation overall — ahead at the tiny coarse levels (1.7x at
-    4x5xC196), behind at the large ones (0.7-0.9x at /4 and /8) where XLA's
-    fusion wins. The XLA twin therefore stays the default; ``VFT_PALLAS=1``
-    opts in (useful as the starting point if the cost volume ever needs to
-    fuse with the warp that feeds it).
-    """
-    flag = os.environ.get("VFT_PALLAS", "").strip().lower()
-    if flag in ("1", "true", "yes"):
-        return True
-    return False
 
 
 def interpret_mode() -> bool:
@@ -74,6 +41,6 @@ from .cost_volume import cost_volume  # noqa: E402
 from .corr_lookup import corr_lookup_onehot, corr_lookup_pallas  # noqa: E402
 
 __all__ = [
-    "pallas_enabled", "interpret_mode",
+    "interpret_mode",
     "cost_volume", "corr_lookup_onehot", "corr_lookup_pallas",
 ]

@@ -1,34 +1,33 @@
-"""PWC-Net 81-channel cost volume as a Pallas TPU kernel.
+"""PWC-Net 81-channel cost volume — XLA shifted-window formulation.
 
 Replaces the reference's raw-CUDA correlation kernel (reference
 models/pwc/pwc_src/correlation.py:47-115: output channel ``(dy+4)*9+(dx+4)``
 is the channel-mean of ``f1 * shift(f2, dy, dx)`` with 4 px zero padding).
+The 81 displacement windows are expressed as static slices of a padded
+``f2``; XLA fuses the multiply-reduce chain into a handful of kernels.
 
-TPU design (not a translation of the CUDA kernel's shared-memory layout):
-
-  - channel-major tiles: inputs are transposed to (B, C, H, W) so the wide
-    spatial W axis sits on the 128-lane dimension and the reduction over C
-    runs across sublane groups — lane utilization is set by W, not by the
-    (often small: 32..196) channel count;
-  - the second feature map is kept in HBM and each program DMAs exactly its
-    (C, TH+2r, W+2r) halo block into VMEM scratch once, then all 81
-    displacement windows are strided reads of that scratch — f2 moves from
-    HBM once per row-tile instead of 81 times;
-  - the 81 multiply-reduce windows write one (TH, W) channel plane each,
-    contiguous vector stores.
-
-Grid: (B, H/TH). The XLA twin (81 shifted multiply-reduces, fused by XLA) is
-kept for CPU and as a fallback; parity is tested in interpret mode.
+MEASURED NEGATIVE RESULT — why there is no Pallas kernel here (round-5
+keep-or-delete decision, VERDICT r4 #8). Rounds 2-4 carried a Pallas twin
+(halo-DMA'd second feature map, channel-major VMEM tiles, f32
+accumulation) that was hardware-validated clean on all 15 real PWC pyramid
+shapes after lane/sublane padding fixes. Timed on v5e with D2H-fenced
+best-of-3 over every (3 geometries x 5 decoder levels) shape in BOTH f32
+and bf16 (scripts history; round-5 run, 30 combos): the two
+implementations are within noise of each other everywhere — e.g. f32
+L2 48x112xC32: pallas 22.9 vs xla 24.3 ms; f32 L6 4x5xC196: 3.6 vs 3.5;
+bf16 L4 16x20xC96: 3.4 vs 4.6; bf16 L6 2x2xC196: 4.1 vs 2.9 — with no
+shape class where Pallas wins consistently. The op is bandwidth-bound and
+XLA's fusion already reaches the same HBM traffic; the per-call floor is
+dispatch latency, which a custom kernel cannot remove. Per the pattern
+established for the lane-dense corr lookup (kernels/corr_lookup.py
+docstring), the tied kernel is DELETED rather than shipped disabled; this
+note and the numbers are the record. If the cost volume ever needs to
+fuse with the warp that feeds it (the one case XLA cannot express), start
+from git history: the kernel lived here until round 5.
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
-import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 
 def cost_volume_xla(f1: jnp.ndarray, f2: jnp.ndarray,
@@ -41,85 +40,11 @@ def cost_volume_xla(f1: jnp.ndarray, f2: jnp.ndarray,
         for dx in range(-radius, radius + 1):
             win = f2p[:, radius + dy:radius + dy + h,
                       radius + dx:radius + dx + w, :]
-            out.append(jnp.mean(f1 * win, axis=-1))
-    return jnp.stack(out, axis=-1)
+            # f32 accumulation regardless of input dtype (bf16 mode: a
+            # 196-term bf16 channel sum costs ~1% relative error)
+            out.append(jnp.mean(f1 * win, axis=-1, dtype=jnp.float32))
+    return jnp.stack(out, axis=-1).astype(f1.dtype)
 
 
-def _kernel(f1_ref, f2p_ref, out_ref, scratch, sem, *, th: int, radius: int,
-            w: int):
-    bi = pl.program_id(0)
-    ti = pl.program_id(1)
-    d = 2 * radius + 1
-    c = scratch.shape[0]
-    dma = pltpu.make_async_copy(
-        f2p_ref.at[bi, :, pl.ds(ti * th, th + 2 * radius), :], scratch, sem)
-    dma.start()
-    dma.wait()
-    f1v = f1_ref[0].astype(jnp.float32)  # (C, TH, W)
-    inv_c = 1.0 / c
-    for dy in range(d):
-        for dx in range(d):
-            win = scratch[:, dy:dy + th, dx:dx + w].astype(jnp.float32)
-            out_ref[0, dy * d + dx] = jnp.sum(f1v * win, axis=0) * inv_c
-
-
-@functools.partial(jax.jit, static_argnames=("radius", "interpret", "tile_h"))
-def cost_volume_pallas(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
-                       interpret: bool = False,
-                       tile_h: int = 32) -> jnp.ndarray:
-    b, h, w, c = f1.shape
-    d = 2 * radius + 1
-    # rows pad to an 8-SUBLANE multiple before tiling: PWC's coarse pyramid
-    # levels have h in {2..14}, and a block sublane dim that is not a
-    # multiple of 8 faults Mosaic on real hardware (hardware-validated
-    # across every real pyramid shape; invisible in interpret mode)
-    h8 = -(-h // 8) * 8
-    th = min(tile_h, h8)
-    hp = -(-h8 // th) * th  # then to a tile multiple; cropped after
-    # the f1/out width ALSO must be lane-aligned: an un-128-multiple W in
-    # the block shapes faults Mosaic on real hardware (observed as a TPU
-    # worker crash at W=64 — invisible in interpret mode)
-    wp = -(-w // 128) * 128
-    f1t = jnp.moveaxis(f1, -1, 1)  # (B, C, H, W) channel-major
-    f2t = jnp.moveaxis(f2, -1, 1)
-    f1t = jnp.pad(f1t, ((0, 0), (0, 0), (0, hp - h), (0, wp - w)))
-    # the halo DMA slices f2p along rows only, so its lane (width) dim must
-    # stay whole-and-tile-aligned for Mosaic: pad W+2r up to a 128 multiple
-    w2 = -(-(wp + 2 * radius) // 128) * 128
-    f2p = jnp.pad(f2t, ((0, 0), (0, 0),
-                        (radius, radius + hp - h),
-                        (radius, w2 - w - radius)))
-    out = pl.pallas_call(
-        functools.partial(_kernel, th=th, radius=radius, w=wp),
-        grid=(b, hp // th),
-        in_specs=[
-            pl.BlockSpec((1, c, th, wp), lambda bi, ti: (bi, 0, ti, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),  # f2p stays in HBM
-        ],
-        out_specs=pl.BlockSpec((1, d * d, th, wp),
-                               lambda bi, ti: (bi, 0, ti, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, d * d, hp, wp), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((c, th + 2 * radius, w2), f2p.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-        interpret=interpret,
-    )(f1t, f2p)
-    # accumulate in f32, return the input dtype like the XLA twin does
-    return jnp.moveaxis(out[:, :, :h, :w], 1, -1).astype(f1.dtype)
-
-
-def cost_volume(f1: jnp.ndarray, f2: jnp.ndarray, radius: int = 4,
-                impl: Optional[str] = None) -> jnp.ndarray:
-    """Dispatching wrapper; see package docstring for ``impl`` semantics."""
-    from . import interpret_mode, pallas_enabled
-    if impl is None:
-        impl = "pallas" if pallas_enabled() else "xla"
-    if impl == "pallas":
-        return cost_volume_pallas(f1, f2, radius, interpret=interpret_mode())
-    if impl != "xla":
-        raise ValueError(f"cost_volume impl={impl!r}: expected "
-                         "'pallas' or 'xla'")
-    return cost_volume_xla(f1, f2, radius)
+#: single implementation since round 5 (see module docstring)
+cost_volume = cost_volume_xla

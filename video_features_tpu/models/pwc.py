@@ -59,8 +59,9 @@ def correlation_volume(f1: jnp.ndarray, f2: jnp.ndarray,
 
     (B, H, W, C) x2 -> (B, H, W, (2r+1)^2); channel (dy+r)*(2r+1)+(dx+r) is
     the channel-mean of ``f1 * shift(f2, dy, dx)`` with zero padding.
-    Dispatches to the Pallas halo-DMA kernel on TPU and the XLA
-    shifted-window formulation elsewhere (kernels/cost_volume.py).
+    XLA shifted-window formulation with f32 accumulation — the single
+    implementation since round 5 (a Pallas twin measured tied and was
+    deleted; kernels/cost_volume.py docstring records the numbers).
     """
     from ..kernels.cost_volume import cost_volume
     return cost_volume(f1, f2, radius)
@@ -70,16 +71,21 @@ def bilinear_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
     """``Backward`` (pwc_net.py:25-50): sample ``feat`` at ``grid + flow``
     with torch-1.2 grid_sample semantics (align_corners=True, zeros
     padding), then zero out samples whose all-ones-channel came back < 1
-    after the same interpolation (the partial-visibility mask)."""
+    after the same interpolation (the partial-visibility mask).
+
+    Coordinate math is ALWAYS f32: bf16's 8 mantissa bits resolve only
+    ~2 px at x=448, which would quantize the sampling grid itself. Only
+    the feature gather/blend runs in the feature dtype."""
     b, h, w, c = feat.shape
-    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=flow.dtype),
-                          jnp.arange(h, dtype=flow.dtype))
-    x = gx[None] + flow[..., 0]
-    y = gy[None] + flow[..., 1]
+    flow32 = flow.astype(jnp.float32)
+    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=jnp.float32),
+                          jnp.arange(h, dtype=jnp.float32))
+    x = gx[None] + flow32[..., 0]
+    y = gy[None] + flow32[..., 1]
     x0, y0 = jnp.floor(x), jnp.floor(y)
 
-    sampled = jnp.zeros(feat.shape, feat.dtype)
-    ones = jnp.zeros((b, h, w), feat.dtype)
+    sampled = jnp.zeros(feat.shape, jnp.float32)
+    ones = jnp.zeros((b, h, w), jnp.float32)
     for xi, wx in ((x0, 1.0 - (x - x0)), (x0 + 1, x - x0)):
         for yi, wy in ((y0, 1.0 - (y - y0)), (y0 + 1, y - y0)):
             valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
@@ -87,11 +93,11 @@ def bilinear_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
             yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
             corner = feat[jnp.arange(b)[:, None, None], yc, xc]
             weight = jnp.where(valid, wx * wy, 0.0)
-            sampled = sampled + weight[..., None] * corner
+            sampled = sampled + weight[..., None] * corner.astype(jnp.float32)
             ones = ones + weight
     # mask rule (pwc_net.py:47-49): >0.999 -> 1, anything below -> 0
-    mask = (ones > 0.999).astype(feat.dtype)
-    return sampled * mask[..., None]
+    mask = (ones > 0.999).astype(jnp.float32)
+    return (sampled * mask[..., None]).astype(feat.dtype)
 
 
 def conv_transpose_4s2p1(x: jnp.ndarray, kernel: jnp.ndarray,
@@ -108,6 +114,7 @@ def conv_transpose_4s2p1(x: jnp.ndarray, kernel: jnp.ndarray,
 
 class Extractor(nn.Module):
     """pwc_net.py:53-119: 6 stages of [stride-2 conv, conv, conv]."""
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> List[jnp.ndarray]:
@@ -115,15 +122,18 @@ class Extractor(nn.Module):
         for stage, ch in _PYRAMID:
             for idx in (0, 2, 4):
                 x = leaky(nn.Conv(ch, (3, 3), strides=2 if idx == 0 else 1,
-                                  padding=1, name=f"{stage}_{idx}")(x))
+                                  padding=1, dtype=self.dtype,
+                                  name=f"{stage}_{idx}")(x))
             feats.append(x)
         return feats
 
 
 class Decoder(nn.Module):
     """pwc_net.py:125-211: cost volume + DenseNet concat stack. Returns
-    (flow, feat)."""
+    (flow, feat). Flow tensors stay f32 in bf16 mode — they feed the warp
+    grid, where bf16 resolution is the coordinate itself."""
     level: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, first: jnp.ndarray, second: jnp.ndarray,
@@ -135,35 +145,48 @@ class Decoder(nn.Module):
             up_k = self.param("moduleUpflow_kernel", nn.initializers.normal(),
                               (4, 4, 2, 2))
             up_b = self.param("moduleUpflow_bias", nn.initializers.zeros, (2,))
-            flow = conv_transpose_4s2p1(prev_flow, up_k, up_b)
+            flow = conv_transpose_4s2p1(prev_flow.astype(jnp.float32),
+                                        up_k.astype(jnp.float32),
+                                        up_b.astype(jnp.float32))
             uf_in = prev_feat.shape[-1]
             uf_k = self.param("moduleUpfeat_kernel", nn.initializers.normal(),
                               (4, 4, uf_in, 2))
             uf_b = self.param("moduleUpfeat_bias", nn.initializers.zeros, (2,))
-            upfeat = conv_transpose_4s2p1(prev_feat, uf_k, uf_b)
+            upfeat = conv_transpose_4s2p1(
+                prev_feat.astype(self.dtype), uf_k.astype(self.dtype),
+                uf_b.astype(self.dtype))
             warped = bilinear_warp(second, flow * _DBL_BACKWARD[self.level])
             volume = leaky(correlation_volume(first, warped))
-            feat = jnp.concatenate([volume, first, flow, upfeat], axis=-1)
+            feat = jnp.concatenate(
+                [volume, first, flow.astype(self.dtype),
+                 upfeat.astype(self.dtype)], axis=-1)
 
         for name, ch in (("moduleOne", 128), ("moduleTwo", 128),
                          ("moduleThr", 96), ("moduleFou", 64),
                          ("moduleFiv", 32)):
-            y = leaky(nn.Conv(ch, (3, 3), padding=1, name=f"{name}_0")(feat))
+            y = leaky(nn.Conv(ch, (3, 3), padding=1, dtype=self.dtype,
+                              name=f"{name}_0")(feat))
             feat = jnp.concatenate([y, feat], axis=-1)  # new features FIRST
-        flow = nn.Conv(2, (3, 3), padding=1, name="moduleSix_0")(feat)
+        # the flow head accumulates in f32: its output is coordinates
+        flow = nn.Conv(2, (3, 3), padding=1, dtype=jnp.float32,
+                       name="moduleSix_0")(feat.astype(jnp.float32))
         return flow, feat
 
 
 class Refiner(nn.Module):
     """pwc_net.py:213-235: dilated context network."""
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         specs = ((128, 1, 0), (128, 2, 2), (128, 4, 4), (96, 8, 6),
                  (64, 16, 8), (32, 1, 10), (2, 1, 12))
         for ch, dil, idx in specs:
+            # the last conv emits flow residual (coordinates): f32 head
+            dt = self.dtype if idx < 12 else jnp.float32
             y = nn.Conv(ch, (3, 3), padding=dil, kernel_dilation=dil,
-                        name=f"moduleMain_{idx}")(x)
+                        dtype=dt, name=f"moduleMain_{idx}")(
+                x if idx < 12 else x.astype(jnp.float32))
             x = leaky(y) if idx < 12 else y
         return x
 
@@ -177,7 +200,13 @@ def _resize_bilinear(x: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
 
 class PWCNet(nn.Module):
     """(B, H, W, 3) RGB [0,255] pairs -> (B, H, W, 2) flow in pixels
-    (pwc_net.py:238-296)."""
+    (pwc_net.py:238-296).
+
+    ``dtype=jnp.bfloat16`` runs the conv stacks and cost volumes on the
+    MXU-native dtype; flow tensors, warp-grid math, the flow heads and the
+    cost-volume accumulation stay f32 (they carry coordinates, where bf16
+    resolution IS the error). Output is always f32."""
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, image1: jnp.ndarray,
@@ -192,9 +221,9 @@ class PWCNet(nn.Module):
             image1 = _resize_bilinear(image1, hp, wp)
             image2 = _resize_bilinear(image2, hp, wp)
 
-        extractor = Extractor(name="moduleExtractor")
-        firsts = extractor(image1)
-        seconds = extractor(image2)
+        extractor = Extractor(dtype=self.dtype, name="moduleExtractor")
+        firsts = extractor(image1.astype(self.dtype))
+        seconds = extractor(image2.astype(self.dtype))
 
         prev = None
         # coarse-to-fine: level 6 (1/64) down to 2 (1/4) (pwc_net.py:277-287)
@@ -202,12 +231,13 @@ class PWCNet(nn.Module):
                             (4, "moduleFou"), (3, "moduleThr"),
                             (2, "moduleTwo")):
             idx = level - 1  # pyramid list is fine-to-coarse
-            flow, feat = Decoder(level, name=name)(
+            flow, feat = Decoder(level, dtype=self.dtype, name=name)(
                 firsts[idx], seconds[idx], prev)
             prev = (flow, feat)
 
-        flow = prev[0] + Refiner(name="moduleRefiner")(prev[1])
-        flow = 20.0 * _resize_bilinear(flow, h, w)
+        flow = prev[0] + Refiner(dtype=self.dtype, name="moduleRefiner")(
+            prev[1])
+        flow = 20.0 * _resize_bilinear(flow.astype(jnp.float32), h, w)
         scale = jnp.array([w / wp, h / hp], dtype=flow.dtype)
         return flow * scale
 

@@ -676,8 +676,11 @@ def main() -> None:
              pwc_note)):
         if value is None:
             continue
+        # the torch baseline runs the reference's RAFT flow; a PWC-flow
+        # ratio against it would be a cross-model comparison, not the
+        # same-work-unit claim BASELINE_DESC makes
         ratio = (value / i3d_torch
-                 if i3d_torch and i3d_torch == i3d_torch else None)
+                 if flow_kind == "raft" and i3d_torch else None)
         metrics.append({
             "metric": f"i3d rgb+flow({flow_kind}) {I3D_STACK}f@{I3D_SIDE}px "
                       f"stack throughput ({platform}, {label})",
@@ -700,11 +703,15 @@ def main() -> None:
          "examples/sec/chip", None),
         ("raft sintel 20-iter flow @240x320 (f32, matmul=highest)",
          bench_raft_standalone, "pairs/sec/chip", None),
-        ("pwc flow @256x448", bench_pwc_standalone, "pairs/sec/chip",
+        ("pwc flow @256x448 (f32, standalone default)",
+         bench_pwc_standalone, "pairs/sec/chip",
          "no torch-cpu baseline EXISTS: the reference PWC correlation is "
          "a CUDA-only CuPy kernel (models/pwc/pwc_src/correlation.py); "
          "running at all without a GPU/second conda env is the parity "
-         "delta"),
+         "delta. Round-5 re-measure was 149.6 vs r4's 51.3 with no "
+         "interleaved A/B across the boundary — unattributed (tunnel "
+         "jitter spans 10x); treat cross-round deltas on this row with "
+         "suspicion"),
     ]
     for name, fn, unit, note in families:
         try:

@@ -154,3 +154,21 @@ def test_end_to_end_extraction(sample_video, tmp_path):
     assert (c, h, w) == (2, 84, 112)
     assert n == 17 and len(feats["timestamps_ms"]) == 18
     assert (tmp_path / "out" / "pwc" / f"{Path(sample_video).stem}_pwc.npy").exists()
+
+
+def test_precision_bfloat16_wires_model_dtype(tmp_path, monkeypatch):
+    """precision=bfloat16 must reach PWCNet.dtype (wiring only)."""
+    import jax.numpy as jnp
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "w"))
+    for precision, want in (("float32", jnp.float32),
+                            ("bfloat16", jnp.bfloat16)):
+        args = load_config("pwc", parse_dotlist([
+            "feature_type=pwc", "device=cpu", f"precision={precision}",
+            "allow_random_weights=true", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}", "video_paths=x.mp4"]))
+        sanity_check(args)
+        ex = get_extractor_cls("pwc")(args)
+        assert ex.model.dtype == want, precision

@@ -225,3 +225,22 @@ def test_bfloat16_mode_close_to_f32(rng):
     # loose bound: random weights amplify bf16 noise vs trained ones
     assert np.median(d) < 0.1 and np.percentile(d, 99) < 1.0, \
         (np.median(d), np.percentile(d, 99))
+
+
+def test_precision_bfloat16_wires_model_dtype(tmp_path, monkeypatch):
+    """precision=bfloat16 must reach RAFT.dtype (and f32 stay default) —
+    wiring only, no forward (bf16 CPU compiles are minutes-slow)."""
+    import jax.numpy as jnp
+    from video_features_tpu.config import load_config, parse_dotlist, \
+        sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path / "w"))
+    for precision, want in (("float32", jnp.float32),
+                            ("bfloat16", jnp.bfloat16)):
+        args = load_config("raft", parse_dotlist([
+            "feature_type=raft", "device=cpu", f"precision={precision}",
+            "allow_random_weights=true", f"output_path={tmp_path / 'o'}",
+            f"tmp_path={tmp_path / 't'}", "video_paths=x.mp4"]))
+        sanity_check(args)
+        ex = get_extractor_cls("raft")(args)
+        assert ex.model.dtype == want, precision

@@ -31,7 +31,12 @@ class ExtractPWC(OpticalFlowExtractor):
 
     def __init__(self, args: Config) -> None:
         super().__init__(args)
-        self.model = pwc_model.PWCNet()
+        # precision=bfloat16: conv stacks + cost volumes on the MXU-native
+        # dtype (flow tensors/warp grid/heads stay f32 — models/pwc.py).
+        # Measured drift 0.015 px max; default f32 is the bit-parity path.
+        dtype = (jnp.bfloat16 if self.precision == "bfloat16"
+                 else jnp.float32)
+        self.model = pwc_model.PWCNet(dtype=dtype)
         params = store.resolve_params(
             "pwc_sintel", pwc_model.init_params, pwc_model.params_from_torch,
             weights_path=args.get("weights_path"),

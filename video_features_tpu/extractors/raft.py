@@ -43,12 +43,20 @@ class ExtractRAFT(OpticalFlowExtractor):
             raise ValueError(
                 f"iters={iters}: RAFT needs at least one GRU refinement "
                 "iteration")
-        self.model = raft_model.RAFT(iters=iters)
+        # precision=bfloat16: conv stacks on the MXU-native dtype (pyramid,
+        # lookup and coords stay f32 — models/raft.py). ~0.1 px drift on
+        # the output flow field; default f32 remains the bit-parity path.
+        dtype = (jnp.bfloat16 if self.precision == "bfloat16"
+                 else jnp.float32)
+        self.model = raft_model.RAFT(iters=iters, dtype=dtype)
         params = store.resolve_params(
             f"raft_{finetuned_on}", raft_model.init_params,
             raft_model.params_from_torch,
             weights_path=args.get("weights_path"),
             allow_random=bool(args.get("allow_random_weights", False)))
+        if dtype is not jnp.float32:
+            from ..parallel.mesh import cast_floating
+            params = cast_floating(params, dtype)
         mesh = self._data_mesh()
         self._init_flow_runner(partial(_raft_forward, self.model), params,
                                mesh)

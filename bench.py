@@ -546,25 +546,31 @@ def bench_vggish(batch: int = 256, iters: int = 20):
 
 
 def bench_raft_standalone(batch: int = 32, h: int = 240, w: int = 320,
-                          iters: int = 10):
+                          iters: int = 10, bf16: bool = False):
     """(flow fields/sec at the sample video's geometry, 20 GRU iterations)
     — the standalone raft extractor's work unit, f32 with the extractor's
     matmul-precision pin (there the flow field IS the output; the pin is
     set globally by extractors/base.py, so the context manager here
-    reproduces the production numerics)."""
+    reproduces the production numerics). ``bf16`` measures the opt-in
+    ``precision=bfloat16`` standalone mode (~0.1 px drift)."""
     import jax
     import jax.numpy as jnp
     from video_features_tpu.extractors.raft import _raft_forward
     from video_features_tpu.models import raft as raft_m
+    from video_features_tpu.parallel.mesh import cast_floating
 
-    model = raft_m.RAFT(iters=raft_m.ITERS)
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    model = raft_m.RAFT(iters=raft_m.ITERS, dtype=dtype)
     params = raft_m.init_params()
+    if bf16:
+        params = cast_floating(params, dtype)
     step = jax.jit(lambda p, x: _raft_forward(model, p, x))
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.integers(
         0, 255, size=(batch, 2, h, w, 3), dtype=np.uint8))
         for _ in range(2)]
-    with jax.default_matmul_precision("highest"):  # precision baked at trace
+    with jax.default_matmul_precision(
+            "highest" if not bf16 else "default"):
         ours = _device_rate(step, [(params, d) for d in data], batch, iters)
 
     def torch_baseline():
@@ -583,17 +589,20 @@ def bench_raft_standalone(batch: int = 32, h: int = 240, w: int = 320,
 
 
 def bench_pwc_standalone(batch: int = 32, h: int = 256, w: int = 448,
-                         iters: int = 10):
+                         iters: int = 10, bf16: bool = False):
     """(flow fields/sec; torch baseline None BY CONSTRUCTION — the
     reference PWC correlation is a CUDA-only CuPy kernel and cannot run on
     this host at all, models/pwc/pwc_src/correlation.py. That this chain
-    runs on TPU without a second conda env is itself the parity win.)"""
+    runs on TPU without a second conda env is itself the parity win.)
+
+    ``bf16`` measures the opt-in ``precision=bfloat16`` standalone mode
+    (models/pwc.py dtype; 0.015 px measured drift)."""
     import jax
     import jax.numpy as jnp
     from video_features_tpu.extractors.pwc import _pwc_forward
     from video_features_tpu.models import pwc as pwc_m
 
-    model = pwc_m.PWCNet()
+    model = pwc_m.PWCNet(dtype=jnp.bfloat16 if bf16 else jnp.float32)
     params = pwc_m.init_params()
     step = jax.jit(lambda p, x: _pwc_forward(model, p, x))
     rng = np.random.default_rng(0)
@@ -712,6 +721,14 @@ def main() -> None:
          "interleaved A/B across the boundary — unattributed (tunnel "
          "jitter spans 10x); treat cross-round deltas on this row with "
          "suspicion"),
+        ("pwc flow @256x448 (opt-in precision=bfloat16, 0.015 px drift)",
+         lambda: bench_pwc_standalone(bf16=True), "pairs/sec/chip", None),
+        # bf16 raft: no torch ratio — the baseline is f32 numerics, and the
+        # f32 row above already carries it for the same work unit
+        ("raft sintel 20-iter flow @240x320 (opt-in precision=bfloat16, "
+         "~0.1 px drift)",
+         lambda: (bench_raft_standalone(bf16=True)[0], None),
+         "pairs/sec/chip", None),
     ]
     for name, fn, unit, note in families:
         try:

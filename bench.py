@@ -151,18 +151,40 @@ def _device_rate(step, args_list, units_per_iter, iters: int,
                  warmup: int = 3, trials: int = TRIALS) -> float:
     """Best-of-trials units/sec for a jitted step over pre-staged device
     batches (see the module docstring's measurement notes: D2H-fenced via
-    ``settle``, inputs resident before the timed loop)."""
+    ``settle``, inputs resident before the timed loop). Single-variant
+    case of :func:`_device_rate_ab` so the timing discipline lives once."""
+    return _device_rate_ab([(step, args_list)], units_per_iter, iters,
+                           warmup, trials)[0]
+
+
+def _device_rate_ab(variants, units_per_iter, iters: int,
+                    warmup: int = 3, trials: int = TRIALS) -> list:
+    """Interleaved twin of :func:`_device_rate` for VARIANT COMPARISONS.
+
+    ``variants`` is a list of (step, args_list); every trial round times
+    ALL variants back-to-back and each variant keeps its best trial. On
+    this rig a sequential pair of rows can land in different tunnel
+    phases and invert a real ordering (observed: pwc bf16 'measured' 39
+    pairs/s in a slow phase vs 159 interleaved minutes earlier) — the
+    rig discipline (docs/performance.md) says cross-variant claims must
+    come from alternating timings in ONE process. Returns best units/sec
+    per variant, same order.
+    """
     from video_features_tpu.parallel.mesh import settle
-    settle(step(*args_list[0]))  # compile
-    for _ in range(warmup):
-        settle(step(*args_list[1 % len(args_list)]))
-    best = 0.0
-    for _ in range(trials):  # best-of: transient tenancy stalls
-        t0 = time.perf_counter()
-        for i in range(iters):
-            out = step(*args_list[i % len(args_list)])
-        settle(out)
-        best = max(best, units_per_iter * iters / (time.perf_counter() - t0))
+    for step, args_list in variants:
+        settle(step(*args_list[0]))  # compile
+        for _ in range(warmup):
+            settle(step(*args_list[1 % len(args_list)]))
+    best = [0.0] * len(variants)
+    for _ in range(trials):
+        for vi, (step, args_list) in enumerate(variants):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                out = step(*args_list[i % len(args_list)])
+            settle(out)
+            best[vi] = max(best[vi],
+                           units_per_iter * iters
+                           / (time.perf_counter() - t0))
     return best
 
 
@@ -545,33 +567,48 @@ def bench_vggish(batch: int = 256, iters: int = 20):
     return ours, torch_baseline
 
 
-def bench_raft_standalone(batch: int = 32, h: int = 240, w: int = 320,
-                          iters: int = 10, bf16: bool = False):
-    """(flow fields/sec at the sample video's geometry, 20 GRU iterations)
-    — the standalone raft extractor's work unit, f32 with the extractor's
-    matmul-precision pin (there the flow field IS the output; the pin is
-    set globally by extractors/base.py, so the context manager here
-    reproduces the production numerics). ``bf16`` measures the opt-in
-    ``precision=bfloat16`` standalone mode (~0.1 px drift)."""
+#: (f32_rate, bf16_rate, torch_baseline_fn) per flow family — each pair
+#: measured INTERLEAVED in one _device_rate_ab call, cached so the two
+#: bench rows share one measurement instead of landing in different
+#: tunnel phases
+_FLOW_PAIRS = {}
+
+
+def _raft_standalone_pair():
+    """Standalone raft extractor work unit (20 GRU iterations at the
+    sample video's geometry, batch 32): f32 with the extractor's matmul-
+    precision pin (the flow field IS the output) and the opt-in
+    precision=bfloat16 mode (~0.1 px drift), interleaved. Geometry is
+    fixed (the cache is keyed by family only)."""
+    if "raft" in _FLOW_PAIRS:
+        return _FLOW_PAIRS["raft"]
+    batch, h, w, iters = 32, 240, 320, 10
     import jax
     import jax.numpy as jnp
     from video_features_tpu.extractors.raft import _raft_forward
     from video_features_tpu.models import raft as raft_m
     from video_features_tpu.parallel.mesh import cast_floating
 
-    dtype = jnp.bfloat16 if bf16 else jnp.float32
-    model = raft_m.RAFT(iters=raft_m.ITERS, dtype=dtype)
     params = raft_m.init_params()
-    if bf16:
-        params = cast_floating(params, dtype)
-    step = jax.jit(lambda p, x: _raft_forward(model, p, x))
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.integers(
         0, 255, size=(batch, 2, h, w, 3), dtype=np.uint8))
         for _ in range(2)]
-    with jax.default_matmul_precision(
-            "highest" if not bf16 else "default"):
-        ours = _device_rate(step, [(params, d) for d in data], batch, iters)
+
+    m32 = raft_m.RAFT(iters=raft_m.ITERS, dtype=jnp.float32)
+    # the f32 extractor pins matmul precision globally (base.py); bake the
+    # pin into THIS step only, at trace time
+    step32 = jax.jit(lambda p, x: _with_highest(_raft_forward, m32, p, x))
+    m16 = raft_m.RAFT(iters=raft_m.ITERS, dtype=jnp.bfloat16)
+    p16 = cast_floating(params, jnp.bfloat16)
+    # pin "default" at trace time too: an extractor constructed earlier in
+    # the same process sets the GLOBAL highest-precision config
+    # (extractors/base.py), which would silently upcast this variant
+    step16 = jax.jit(lambda p, x: _with_default(_raft_forward, m16, p, x))
+
+    f32_v, bf16_v = _device_rate_ab(
+        [(step32, [(params, d) for d in data]),
+         (step16, [(p16, d) for d in data])], batch, iters)
 
     def torch_baseline():
         import torch
@@ -585,32 +622,54 @@ def bench_raft_standalone(batch: int = 32, h: int = 240, w: int = 320,
             m(x, x, iters=2)
         return _torch_seconds_per_call(
             lambda: m(x, x, iters=20, test_mode=True))
-    return ours, torch_baseline
+
+    _FLOW_PAIRS["raft"] = (f32_v, bf16_v, torch_baseline)
+    return _FLOW_PAIRS["raft"]
 
 
-def bench_pwc_standalone(batch: int = 32, h: int = 256, w: int = 448,
-                         iters: int = 10, bf16: bool = False):
+def _with_highest(fn, *args):
+    import jax
+    with jax.default_matmul_precision("highest"):
+        return fn(*args)
+
+
+def _with_default(fn, *args):
+    import jax
+    with jax.default_matmul_precision("default"):
+        return fn(*args)
+
+
+def _pwc_standalone_pair():
     """(flow fields/sec; torch baseline None BY CONSTRUCTION — the
     reference PWC correlation is a CUDA-only CuPy kernel and cannot run on
     this host at all, models/pwc/pwc_src/correlation.py. That this chain
     runs on TPU without a second conda env is itself the parity win.)
-
-    ``bf16`` measures the opt-in ``precision=bfloat16`` standalone mode
-    (models/pwc.py dtype; 0.015 px measured drift)."""
+    f32 default and the opt-in precision=bfloat16 mode (0.015 px drift),
+    interleaved at batch 32 @256x448 (cache keyed by family only)."""
+    if "pwc" in _FLOW_PAIRS:
+        return _FLOW_PAIRS["pwc"]
+    batch, h, w, iters = 32, 256, 448, 10
     import jax
     import jax.numpy as jnp
     from video_features_tpu.extractors.pwc import _pwc_forward
     from video_features_tpu.models import pwc as pwc_m
 
-    model = pwc_m.PWCNet(dtype=jnp.bfloat16 if bf16 else jnp.float32)
     params = pwc_m.init_params()
-    step = jax.jit(lambda p, x: _pwc_forward(model, p, x))
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.integers(
         0, 255, size=(batch, 2, h, w, 3), dtype=np.uint8))
         for _ in range(2)]
-    ours = _device_rate(step, [(params, d) for d in data], batch, iters)
-    return ours, None
+    m32 = pwc_m.PWCNet(dtype=jnp.float32)
+    m16 = pwc_m.PWCNet(dtype=jnp.bfloat16)
+    # pin each variant's trace-time matmul precision to its production
+    # extractor config, independent of ambient global state
+    step32 = jax.jit(lambda p, x: _with_highest(_pwc_forward, m32, p, x))
+    step16 = jax.jit(lambda p, x: _with_default(_pwc_forward, m16, p, x))
+    args = [(params, d) for d in data]
+    f32_v, bf16_v = _device_rate_ab(
+        [(step32, args), (step16, args)], batch, iters)
+    _FLOW_PAIRS["pwc"] = (f32_v, bf16_v, None)
+    return _FLOW_PAIRS["pwc"]
 
 
 def main() -> None:
@@ -717,25 +776,29 @@ def main() -> None:
          "stacks/sec/chip", None),
         ("vggish 0.96s log-mel example throughput", bench_vggish,
          "examples/sec/chip", None),
+        # the f32/bf16 pairs below come from ONE interleaved measurement
+        # each (_device_rate_ab): a sequential pair of rows can land in
+        # different tunnel phases and invert the real ordering
         ("raft sintel 20-iter flow @240x320 (f32, matmul=highest)",
-         bench_raft_standalone, "pairs/sec/chip", None),
+         lambda: (_raft_standalone_pair()[0], _raft_standalone_pair()[2]),
+         "pairs/sec/chip", None),
+        # bf16 raft: no torch ratio — the baseline is f32 numerics, and
+        # the f32 row above already carries it for the same work unit
+        ("raft sintel 20-iter flow @240x320 (opt-in precision=bfloat16, "
+         "~0.1 px drift)",
+         lambda: (_raft_standalone_pair()[1], None),
+         "pairs/sec/chip", "interleaved with the f32 row"),
         ("pwc flow @256x448 (f32, standalone default)",
-         bench_pwc_standalone, "pairs/sec/chip",
+         lambda: (_pwc_standalone_pair()[0], None), "pairs/sec/chip",
          "no torch-cpu baseline EXISTS: the reference PWC correlation is "
          "a CUDA-only CuPy kernel (models/pwc/pwc_src/correlation.py); "
          "running at all without a GPU/second conda env is the parity "
-         "delta. Round-5 re-measure was 149.6 vs r4's 51.3 with no "
-         "interleaved A/B across the boundary — unattributed (tunnel "
-         "jitter spans 10x); treat cross-round deltas on this row with "
-         "suspicion"),
+         "delta. Treat cross-ROUND deltas on this row with suspicion "
+         "(tunnel jitter spans 10x between runs); the f32-vs-bf16 pair "
+         "below is interleaved and trustworthy"),
         ("pwc flow @256x448 (opt-in precision=bfloat16, 0.015 px drift)",
-         lambda: bench_pwc_standalone(bf16=True), "pairs/sec/chip", None),
-        # bf16 raft: no torch ratio — the baseline is f32 numerics, and the
-        # f32 row above already carries it for the same work unit
-        ("raft sintel 20-iter flow @240x320 (opt-in precision=bfloat16, "
-         "~0.1 px drift)",
-         lambda: (bench_raft_standalone(bf16=True)[0], None),
-         "pairs/sec/chip", None),
+         lambda: (_pwc_standalone_pair()[1], None), "pairs/sec/chip",
+         "interleaved with the f32 row"),
     ]
     for name, fn, unit, note in families:
         try:

@@ -16,7 +16,14 @@ video produced NO output and is reported failed (not crashed) by exactly
 its owner; the restarted round skips already-done work via the idempotent
 resume contract. Reference behavior anchor: per-video isolation + resume
 in reference models/_base/base_extractor.py:95-127.
+
+Failure-journal contract (utils/faults.py FailureJournal): round 1's
+owner quarantines the corrupt video into ``{output}/_failures.jsonl``
+(exactly one record, category=POISON, retried ``retry_attempts`` times);
+round 2 SKIPS it via the journal ("1 quarantined", "0 failed") instead
+of re-failing it, and appends nothing.
 """
+import json
 import os
 import signal
 import socket
@@ -142,6 +149,24 @@ def test_chaos_distributed_preempt_corrupt_resume(sample_video, tmp_path):
     healthy = {Path(v).stem for v in videos if v != str(corrupt)}
     assert 0 < len(done_r1) < len(healthy)  # work genuinely remains
 
+    # journal contract after round 1: the corrupt video was retried
+    # retry_attempts times (config default) by its owner, then journaled
+    # exactly once as POISON; no healthy video has a record
+    journal_path = feat_dir / "_failures.jsonl"
+    assert journal_path.exists(), "terminal failure must be journaled"
+
+    def journal_records():
+        return [json.loads(l) for l in journal_path.read_text().splitlines()
+                if l.strip()]
+
+    recs = journal_records()
+    assert {r["video"] for r in recs} == {str(corrupt)}, recs
+    assert len(recs) == 1, recs
+    assert recs[0]["category"] == "POISON"
+    assert recs[0]["attempts"] == 3  # configs/r21d.yml retry_attempts
+    assert recs[0]["host"] and "elapsed_s" in recs[0]
+    assert str(corrupt) in shards[corrupt_owner]  # owned by its shard
+
     # ---- round 2: restart both under a fresh coordinator ---------------
     coord = f"127.0.0.1:{_free_port()}"
     procs, logs = zip(*(_spawn(pid, coord, repo, tmp_path / "out",
@@ -169,17 +194,23 @@ def test_chaos_distributed_preempt_corrupt_resume(sample_video, tmp_path):
             arr = np.load(outs[0])  # valid: loads, right shape
             assert arr.ndim == 2 and arr.shape[1] == 512
 
-    # round 2: already-done work skipped (resume), corrupt failed again at
-    # exactly its owner, nothing else failed
+    # round 2: already-done work skipped (resume); the corrupt video is
+    # QUARANTINED via the journal by exactly its owner (no re-decode, no
+    # new record), nothing else failed
     for pid in range(2):
         text = (tmp_path / f"r2_worker_{pid}.log").read_text()
         assert f"WORKER_DONE {pid}" in text, text[-1500:]
         n_own = len(shards[pid])
         if pid == corrupt_owner:
-            assert "1 failed" in text, text[-1500:]
+            assert "is quarantined by" in text, text[-1500:]
             n_skip = len(done_r1 & {Path(v).stem + "_r21d.npy"
                                     for v in shards[pid]})
             assert f"{n_own - 1 - n_skip} extracted, {n_skip} already done, " \
-                   f"1 failed" in text, text[-1500:]
+                   f"0 failed, 1 quarantined" in text, text[-1500:]
         else:
             assert "0 failed" in text, text[-1500:]
+            assert "quarantined" not in text, text[-1500:]
+
+    # the quarantine skip appended nothing: still exactly one record
+    recs = journal_records()
+    assert len(recs) == 1 and recs[0]["category"] == "POISON", recs

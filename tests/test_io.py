@@ -447,3 +447,92 @@ def test_parallel_decode_through_extractor(sample_video, tmp_path,
     np.testing.assert_array_equal(inline["timestamps_ms"],
                                   par["timestamps_ms"])
     np.testing.assert_array_equal(inline["resnet"], par["resnet"])
+
+
+def test_parallel_decode_lying_metadata_falls_back_to_recount(
+        sample_video, monkeypatch, capsys):
+    """ADVICE medium: a container whose metadata reports num_frames<=0 in
+    native-fps mode must fall back to count_frames_by_decode (like the
+    serial resample path) instead of spawning zero workers and silently
+    yielding an empty stream."""
+    from video_features_tpu.utils import io as io_mod
+    real_props = io_mod.get_video_props
+
+    def lying_props(path):
+        props = real_props(path)
+        props["num_frames"] = 0  # metadata lied; fps stays valid
+        return props
+
+    monkeypatch.setattr(io_mod, "get_video_props", lying_props)
+    src = io_mod.ParallelVideoSource(sample_video, decode_workers=2,
+                                     batch_size=64)
+    assert len(src) == 355
+    total = sum(len(b) for b, _, _ in src)
+    assert total == 355
+    assert "counted 355 by decode" in capsys.readouterr().out
+
+
+def test_parallel_decode_lying_metadata_empty_stream_raises(
+        tmp_path, monkeypatch):
+    """Same fallback, but a stream with zero decodable frames must fail
+    loudly, not emit an empty feature."""
+    from video_features_tpu.utils import io as io_mod
+    bad = tmp_path / "empty.mp4"
+    bad.write_bytes(b"\x00" * 2048)
+    monkeypatch.setattr(
+        io_mod, "get_video_props",
+        lambda path: dict(fps=19.62, num_frames=0, height=240, width=320))
+    with pytest.raises(ValueError, match="No decodable frames"):
+        io_mod.ParallelVideoSource(str(bad), decode_workers=2)
+
+
+def test_segment_worker_seek_mismatch_degrades_to_serial(
+        sample_video, monkeypatch, capsys):
+    """ADVICE low: when CAP_PROP_POS_FRAMES does not land where asked
+    (VFR/odd codecs), the segment worker must re-decode serially from
+    frame 0 — same bytes, seek benefit lost — instead of silently
+    emitting wrong frames."""
+    import cv2
+    from video_features_tpu.utils import io as io_mod
+    real_capture = cv2.VideoCapture
+
+    class _NoSeekCap:
+        """Delegates everything but silently ignores frame seeks."""
+
+        def __init__(self, path):
+            self._cap = real_capture(path)
+
+        def set(self, prop, val):
+            if prop == cv2.CAP_PROP_POS_FRAMES:
+                return True  # claims success, does nothing (VFR-style)
+            return self._cap.set(prop, val)
+
+        def __getattr__(self, name):
+            return getattr(self._cap, name)
+
+    class _ListQ:
+        def __init__(self):
+            self.items = []
+
+        def put(self, item):
+            self.items.append(item)
+
+    # serial reference frames for source indices 100..119 (native fps)
+    want = {}
+    for f, _, i in io_mod.VideoSource(sample_video).frames():
+        if 100 <= i < 120:
+            want[i] = f
+
+    monkeypatch.setattr(io_mod.cv2, "VideoCapture", _NoSeekCap)
+    q = _ListQ()
+    seg = dict(src_indices=np.arange(100, 120, dtype=np.int64),
+               out_start=100, fps=19.62, transform=None,
+               channel_order="rgb")
+    io_mod._segment_decode_worker(q, sample_video, seg)
+
+    assert "seek verification failed" in capsys.readouterr().out
+    frames = [p for tag, p in q.items if tag == "frame"]
+    assert q.items[-1] == ("done", 20)
+    assert [idx for _, _, idx in frames] == list(range(100, 120))
+    for x, _, idx in frames:
+        np.testing.assert_array_equal(x, want[idx])

@@ -133,3 +133,52 @@ def test_require_value_tier_fails_loudly_without_weights(tmp_path):
     assert proc.returncode != 0, (
         "required family silently downgraded to shape tier:\n" + joined)
     assert "silently downgraded" in joined
+
+
+def test_ref_blob_refuses_mutable_master(tmp_path, monkeypatch):
+    """ADVICE low: pickled checkpoints served from the reference repo's
+    git tree must not download from the mutable 'master' ref — require
+    an immutable VFT_REF_COMMIT pin or an explicit opt-in."""
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path))
+    monkeypatch.setenv("VFT_FETCH_WEIGHTS", "1")
+    monkeypatch.delenv("VFT_ALLOW_MUTABLE_REF", raising=False)
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(url)
+        return _FakeResponse(PAYLOAD)
+
+    import urllib.request
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    with pytest.raises(RuntimeError, match="VFT_REF_COMMIT"):
+        store.find_checkpoint("raft_sintel")
+    assert calls == [], "refusal must happen BEFORE any network touch"
+    assert not list(tmp_path.iterdir())
+
+
+def test_ref_blob_records_digest_then_verifies(tmp_path, monkeypatch):
+    """Trust-on-first-use for the no-published-digest blobs: the first
+    (explicitly opted-in) fetch records the SHA-256 into
+    ref_digests.json; a later fetch of different bytes is refused."""
+    monkeypatch.setenv("VFT_WEIGHTS_DIR", str(tmp_path))
+    monkeypatch.setenv("VFT_FETCH_WEIGHTS", "1")
+    monkeypatch.setenv("VFT_ALLOW_MUTABLE_REF", "1")
+    payload = [PAYLOAD]
+    import urllib.request
+    monkeypatch.setattr(urllib.request, "urlopen",
+                        lambda url, timeout=None: _FakeResponse(payload[0]))
+
+    p = store.find_checkpoint("raft_sintel")
+    assert p is not None and p.read_bytes() == PAYLOAD
+    assert store.recorded_digest("raft-sintel.pth") == PAYLOAD_SHA
+
+    # swapped upstream bytes on a re-fetch: recorded digest refuses
+    p.unlink()
+    payload[0] = b"tampered bytes" * 64
+    with pytest.raises(RuntimeError, match="recorded digest"):
+        store.find_checkpoint("raft_sintel")
+    assert not (tmp_path / "raft-sintel.pth").exists()
+
+    # same bytes again: verifies cleanly against the record
+    payload[0] = PAYLOAD
+    assert store.find_checkpoint("raft_sintel") is not None

@@ -134,14 +134,28 @@ def main(argv: Optional[List[str]] = None) -> None:
         import os as _os
         workers_arg = max(1, min(8, (_os.cpu_count() or 1) // 2))
     workers = int(workers_arg)
-    tally = {"done": 0, "skipped": 0, "error": 0}
+    tally = {"done": 0, "skipped": 0, "error": 0, "quarantined": 0}
     tally_lock = threading.Lock()
     t_run = time.perf_counter()
+
+    # Fault-tolerance runtime (utils/faults.py): categorized retries with
+    # backoff + the decode degradation ladder per video, a per-video
+    # deadline watchdog, and — for file sinks — the persistent failure
+    # journal that quarantines known-poison inputs across restarts. The
+    # print sink has no resume contract, so it keeps no journal.
+    from .utils.faults import FailureJournal, RetryPolicy
+    policy = RetryPolicy.from_config(args)
+    journal = (FailureJournal(args.output_path)
+               if args.get("on_extraction", "print") != "print" else None)
+    failures: List[dict] = []  # this run's terminal records (GIL-safe append)
 
     def run_one(video_path: str) -> None:
         if stop.is_set():
             return
-        status = safe_extract(extractor._extract, video_path)
+        status = safe_extract(extractor._extract, video_path, policy=policy,
+                              journal=journal,
+                              decode_mode=extractor.video_decode,
+                              on_terminal_failure=failures.append)
         with tally_lock:
             tally[status] += 1
 
@@ -183,11 +197,25 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())
-    print(f"{n_run}/{len(video_paths)} videos in {elapsed:.1f}s: "
-          f"{tally['done']} extracted, {tally['skipped']} already done, "
-          f"{tally['error']} failed"
-          + (f" ({tally['done'] / elapsed:.2f} videos/s)"
-             if tally["done"] else ""))
+    summary = (f"{n_run}/{len(video_paths)} videos in {elapsed:.1f}s: "
+               f"{tally['done']} extracted, {tally['skipped']} already done, "
+               f"{tally['error']} failed")
+    if tally["quarantined"]:
+        summary += f", {tally['quarantined']} quarantined"
+    if failures:
+        by_cat: dict = {}
+        for rec in failures:
+            cat = rec.get("category") or "?"
+            by_cat[cat] = by_cat.get(cat, 0) + 1
+        summary += (" [" + ", ".join(f"{k}={v}"
+                                     for k, v in sorted(by_cat.items()))
+                    + "]")
+    if tally["done"]:
+        summary += f" ({tally['done'] / elapsed:.2f} videos/s)"
+    print(summary)
+    if failures and journal is not None:
+        print(f"failure journal: {journal.path} (retry_failed=true re-runs "
+              "quarantined videos)")
     if profiler.enabled:
         print(profiler.summary(f"profile: {args.feature_type} x "
                                f"{len(video_paths)} videos"))

@@ -234,6 +234,19 @@ def sanity_check(args: Config) -> None:
                     and args.get("extraction_total") is not None), \
             "`extraction_fps` and `extraction_total` are mutually exclusive"
 
+    # fault-tolerance keys (utils/faults.py RetryPolicy.from_config):
+    # validated at launch so a typo fails before N videos burn retries
+    ra = args.get("retry_attempts")
+    if ra is not None and int(ra) < 1:
+        raise ValueError(f"retry_attempts={ra!r}: need an int >= 1")
+    rb = args.get("retry_backoff_s")
+    if rb is not None and float(rb) < 0:
+        raise ValueError(f"retry_backoff_s={rb!r}: need a float >= 0")
+    vd = args.get("video_deadline_s")
+    if vd is not None and float(vd) <= 0:
+        raise ValueError(f"video_deadline_s={vd!r}: need a float > 0 "
+                         "(or null to disable the per-video deadline)")
+
     fps_mode = args.get("fps_mode", "select") or "select"
     if fps_mode not in ("select", "reencode"):
         raise ValueError(

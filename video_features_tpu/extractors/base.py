@@ -62,12 +62,22 @@ class BaseExtractor:
     def video_source(self, video_path: str, **kwargs):
         """Family-agnostic VideoSource factory honoring video_decode and
         fps_mode (``reencode`` = the reference's lossy temp-file decode
-        path for golden/parity runs, utils/io.py module docstring)."""
+        path for golden/parity runs, utils/io.py module docstring).
+
+        Fault-tolerance hooks (utils/faults.py): when a FaultContext is
+        active on this thread, its ``decode_override`` (the degradation
+        ladder's demoted mode for a retry) replaces ``video_decode``, and
+        the constructed source is registered so the per-video deadline
+        watchdog can kill its in-flight decode."""
+        from ..utils import faults
         from ..utils.io import (ParallelVideoSource, ProcessVideoSource,
                                 VideoSource)
+        ctx = faults.current_context()
+        mode = self.video_decode
+        if ctx is not None and ctx.decode_override:
+            mode = ctx.decode_override
         cls = {"process": ProcessVideoSource,
-               "parallel": ParallelVideoSource}.get(self.video_decode,
-                                                    VideoSource)
+               "parallel": ParallelVideoSource}.get(mode, VideoSource)
         if cls is ParallelVideoSource:
             kwargs.setdefault("decode_workers", self.decode_workers)
             if self.decode_depth is not None:
@@ -76,7 +86,10 @@ class BaseExtractor:
             kwargs.setdefault("fps_mode", "reencode")
             kwargs.setdefault("tmp_path", self.args.get("tmp_path", "tmp"))
             kwargs.setdefault("keep_tmp", self.keep_tmp_files)
-        return cls(video_path, **kwargs)
+        src = cls(video_path, **kwargs)
+        if ctx is not None:
+            ctx.register(src)
+        return src
 
     def _data_mesh(self):
         """Device mesh for this extractor's runners.

@@ -35,11 +35,14 @@ ffmpeg-less hosts (docs/performance.md records the measured numbers).
 """
 from __future__ import annotations
 
+import threading
 from pathlib import Path
 from typing import Callable, Iterator, List, Optional, Tuple, Union
 
 import cv2
 import numpy as np
+
+from .faults import DeadlineExceeded
 
 
 def get_video_props(path: Union[str, Path]) -> dict:
@@ -207,11 +210,17 @@ class _FrameStream:
         self._native = channel_order == "bgr"
 
     def read(self) -> Optional[np.ndarray]:
-        ok, frame = self.cap.read()
+        # local ref: a concurrent release() (deadline watchdog) nulls
+        # self.cap; going through the local keeps this thread's call
+        # coherent and the next loop iteration observes the None
+        cap = self.cap
+        if cap is None:
+            return None
+        ok, frame = cap.read()
         if not ok and self._first:
             # cv2 sometimes fails on frame #0 only (reference utils/io.py:99-106)
             print("Detect missing frame")
-            ok, frame = self.cap.read()
+            ok, frame = cap.read()
         self._first = False
         if not ok:
             return None
@@ -227,17 +236,23 @@ class _FrameStream:
         by the fps filter — they pay decode only, never conversion.
         Same frame-0 retry as :meth:`read` (the missing-frame-0 workaround
         shifts indices identically on both paths)."""
-        ok = self.cap.grab()
+        cap = self.cap
+        if cap is None:
+            return False
+        ok = cap.grab()
         if not ok and self._first:
             print("Detect missing frame")
-            ok = self.cap.grab()
+            ok = cap.grab()
         self._first = False
         return ok
 
     def release(self):
-        if self.cap is not None:
-            self.cap.release()
-            self.cap = None
+        # swap-then-release: idempotent and callable from the watchdog
+        # thread while the decode thread is inside read()/skip() — cv2
+        # fails the in-flight call instead of blocking forever
+        cap, self.cap = self.cap, None
+        if cap is not None:
+            cap.release()
 
 
 class VideoSource:
@@ -275,6 +290,13 @@ class VideoSource:
         self.overlap = overlap
         #: 'bgr' defers the RGB reorder into the transform (see _FrameStream)
         self.channel_order = channel_order
+
+        # deadline-watchdog support (utils/faults.py FaultContext):
+        # cancel() is thread-safe and kills the in-flight decode
+        self._cancelled = False
+        self._cancel_reason = ""
+        self._active_stream: Optional[_FrameStream] = None
+        self._state_lock = threading.Lock()
 
         self._tmp_file: Optional[str] = None
         self._keep_tmp = keep_tmp
@@ -331,6 +353,32 @@ class VideoSource:
     def __len__(self):
         return self.num_frames
 
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Thread-safe kill of the in-flight decode (deadline watchdog).
+
+        Marks the source cancelled and releases the active
+        ``_FrameStream`` so a read blocked inside cv2 fails promptly; the
+        iterating thread then raises :class:`DeadlineExceeded` instead of
+        emitting a silently-truncated stream."""
+        with self._state_lock:
+            self._cancelled = True
+            self._cancel_reason = reason or "cancelled"
+            stream = self._active_stream
+        if stream is not None:
+            stream.release()
+
+    def release(self) -> None:
+        """Thread-safe teardown (same surface as ProcessVideoSource /
+        ParallelVideoSource): cancels any in-flight iteration and drops
+        the re-encoded temp file if one exists."""
+        self.cancel("released")
+        self._cleanup_tmp()
+
+    def _raise_if_cancelled(self) -> None:
+        if self._cancelled:
+            raise DeadlineExceeded(
+                f"{self.path}: {self._cancel_reason}")
+
     def _cleanup_tmp(self) -> None:
         tmp, self._tmp_file = self._tmp_file, None
         if tmp and not self._keep_tmp:
@@ -357,6 +405,17 @@ class VideoSource:
                 "pass: its re-encoded temp file was already deleted "
                 "(construct a new source, or pass keep_tmp=True)")
         stream = _FrameStream(self.path, self.channel_order)
+        with self._state_lock:
+            self._active_stream = stream
+        # checked AFTER registering: a cancel() landing between flag-set
+        # and registration is caught here instead of being lost
+        try:
+            self._raise_if_cancelled()
+        except DeadlineExceeded:
+            with self._state_lock:
+                self._active_stream = None
+            stream.release()
+            raise
         tf = self.transform
 
         def emit(rgb, out_idx):
@@ -372,8 +431,13 @@ class VideoSource:
             if self.index_map is None:
                 out_idx = 0
                 while self._total_cap is None or out_idx < self._total_cap:
+                    self._raise_if_cancelled()
                     rgb = timed_read()
                     if rgb is None:
+                        # a watchdog-released stream ends exactly like a
+                        # normal EOF — distinguish them or a killed decode
+                        # would write truncated features as a success
+                        self._raise_if_cancelled()
                         return
                     yield emit(rgb, out_idx)
                     out_idx += 1
@@ -381,6 +445,7 @@ class VideoSource:
                 src_idx = -1
                 current = None
                 for out_idx, want in enumerate(self.index_map):
+                    self._raise_if_cancelled()
                     while src_idx < want:
                         if src_idx < want - 1:
                             # this source frame is dropped by the fps
@@ -393,6 +458,7 @@ class VideoSource:
                             nxt = timed_read()
                             current = nxt
                         if nxt is None:
+                            self._raise_if_cancelled()
                             # container metadata overstated the frame count;
                             # reaching stream end inside this loop always
                             # means the resampled output is short
@@ -405,6 +471,8 @@ class VideoSource:
                         src_idx += 1
                     yield emit(current, out_idx)
         finally:
+            with self._state_lock:
+                self._active_stream = None
             stream.release()
             self._cleanup_tmp()
 
@@ -501,6 +569,8 @@ class ProcessVideoSource:
         self.path = str(path)
         self.batch_size = batch_size
         self.overlap = overlap
+        self._cancelled = False
+        self._cancel_reason = ""
         ctx = mp.get_context("spawn")  # never fork a process holding jax
         self._q = ctx.Queue(maxsize=max(int(depth), 2))
         self._proc = ctx.Process(
@@ -533,18 +603,27 @@ class ProcessVideoSource:
     def __len__(self):
         return self.num_frames
 
+    def _raise_if_cancelled(self) -> None:
+        if self._cancelled:
+            raise DeadlineExceeded(f"{self.path}: {self._cancel_reason}")
+
     def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
         import queue as _queue
         try:
             while True:
+                self._raise_if_cancelled()
                 try:
-                    tag, payload = self._q.get(timeout=5.0)
+                    # 1s poll (not one long get): bounds how stale the
+                    # cancellation/liveness checks above can be
+                    tag, payload = self._q.get(timeout=1.0)
                 except _queue.Empty:
                     # a worker killed without running its except handler
                     # (OOM SIGKILL) can never enqueue 'error'/'done' — fail
                     # the video instead of hanging the extraction thread
-                    if self._proc is not None and self._proc.is_alive():
+                    proc = self._proc
+                    if proc is not None and proc.is_alive():
                         continue
+                    self._raise_if_cancelled()  # watchdog terminated it
                     # the worker may have flushed its tail (frames + 'done')
                     # and exited in the instant between the timeout and the
                     # liveness check: drain before declaring it dead
@@ -555,7 +634,7 @@ class ProcessVideoSource:
                         raise RuntimeError(
                             f"decode worker for {self.path} died without a "
                             "result (killed? exitcode="
-                            f"{getattr(self._proc, 'exitcode', None)})"
+                            f"{getattr(proc, 'exitcode', None)})"
                         ) from None
                 if tag == "frame":
                     yield payload
@@ -569,6 +648,14 @@ class ProcessVideoSource:
 
     def __iter__(self) -> Iterator[Tuple[List, List[float], List[int]]]:
         return _batched(self.frames(), self.batch_size, self.overlap)
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Thread-safe kill (deadline watchdog): terminate the decode
+        child; the consuming thread raises DeadlineExceeded on its next
+        poll instead of misreporting a dead-worker RuntimeError."""
+        self._cancel_reason = reason or "cancelled"
+        self._cancelled = True
+        self.release()
 
     def release(self) -> None:
         proc, self._proc = self._proc, None
@@ -609,6 +696,22 @@ def _segment_decode_worker(q, path: str, seg: dict) -> None:
                 # from the previous keyframe (validated in test_io.py
                 # parallel-vs-serial equality)
                 cap.set(cv2.CAP_PROP_POS_FRAMES, src_pos)
+                got = cap.get(cv2.CAP_PROP_POS_FRAMES)
+                if int(round(got)) != src_pos:
+                    # VFR streams / some codecs seek only approximately;
+                    # a mis-seek would silently break the bit-identical-
+                    # to-serial contract. Degrade THIS video to serial:
+                    # re-open and grab()-skip forward from frame 0
+                    # (correct, one-GOP-cheaper seek benefit lost).
+                    # Constraint documented in docs/performance.md.
+                    print(f"WARNING: seek verification failed for {path} "
+                          f"(wanted frame {src_pos}, CAP_PROP_POS_FRAMES="
+                          f"{got}); decoding this segment serially from "
+                          "frame 0 (video_decode=parallel assumes CFR "
+                          "seekable input)")
+                    cap.release()
+                    cap = cv2.VideoCapture(path)
+                    src_pos = 0
             emitted = 0
             current = None
             cur_idx = src_pos - 1
@@ -700,8 +803,22 @@ class ParallelVideoSource:
         self.num_frames = probe.num_frames
         self.src_num_frames = probe.src_num_frames
         self.height, self.width = probe.height, probe.width
+        self._cancelled = False
+        self._cancel_reason = ""
+        if probe.index_map is None and probe.num_frames <= 0:
+            # native-fps mode with lying container metadata: the resample
+            # path recounts by decode (VideoSource.__init__); without the
+            # same fallback here the index_map would be empty, zero
+            # workers would spawn, and frames() would silently yield an
+            # empty stream where serial decode reaches EOF (ADVICE medium)
+            n = count_frames_by_decode(self.path)
+            if n == 0:
+                raise ValueError(f"No decodable frames in {self.path}")
+            print(f"Warning: {self.path} metadata reported "
+                  f"{probe.num_frames} frames; counted {n} by decode.")
+            self.num_frames = self.src_num_frames = n
         index_map = (probe.index_map if probe.index_map is not None
-                     else np.arange(probe.num_frames, dtype=np.int64))
+                     else np.arange(self.num_frames, dtype=np.int64))
 
         m = len(index_map)
         n = max(1, min(decode_workers, m)) if m else 1
@@ -740,18 +857,28 @@ class ParallelVideoSource:
     def __len__(self):
         return self.num_frames
 
+    def _raise_if_cancelled(self) -> None:
+        if self._cancelled:
+            raise DeadlineExceeded(f"{self.path}: {self._cancel_reason}")
+
     def frames(self) -> Iterator[Tuple[np.ndarray, float, int]]:
         import queue as _queue
+        # local copies: cancel()/release() rebind the attributes to []
+        # concurrently, but iteration order over the original lists stays
+        # coherent for this thread
+        segments = list(zip(self._queues, self._procs, self._expected))
         try:
-            for q, proc, expected in zip(self._queues, self._procs,
-                                         self._expected):
+            for q, proc, expected in segments:
                 emitted = None
                 while emitted is None:
+                    self._raise_if_cancelled()
                     try:
-                        tag, payload = q.get(timeout=5.0)
+                        # 1s poll bounds cancellation/liveness staleness
+                        tag, payload = q.get(timeout=1.0)
                     except _queue.Empty:
                         if proc.is_alive():
                             continue
+                        self._raise_if_cancelled()  # watchdog kill
                         try:
                             tag, payload = q.get_nowait()
                         except _queue.Empty:
@@ -779,6 +906,14 @@ class ParallelVideoSource:
 
     def __iter__(self) -> Iterator[Tuple[List, List[float], List[int]]]:
         return _batched(self.frames(), self.batch_size, self.overlap)
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Thread-safe kill (deadline watchdog): terminate every segment
+        worker; the consuming thread raises DeadlineExceeded on its next
+        poll."""
+        self._cancel_reason = reason or "cancelled"
+        self._cancelled = True
+        self.release()
 
     def release(self) -> None:
         procs, self._procs = self._procs, []

@@ -136,20 +136,106 @@ def action_on_extraction(feats_dict: Dict[str, np.ndarray],
             writer(fpath, value)
 
 
-def safe_extract(extract_fn, video_path: str) -> str:
-    """Run one video; any failure prints a traceback and is non-fatal.
+def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
+                 decode_mode: str = None, on_terminal_failure=None) -> str:
+    """Run one video under the fault-tolerance runtime (utils/faults.py).
 
-    The per-video error isolation of reference base_extractor.py:40-53
-    (KeyboardInterrupt re-raised). Returns ``'done'``, ``'skipped'`` (the
-    idempotent already-exists path returned without extracting), or
-    ``'error'`` — the CLI's run summary tallies these.
+    Extends the per-video error isolation of reference
+    base_extractor.py:40-53 (KeyboardInterrupt still re-raised) with:
+
+      - **quarantine skip**: with a ``journal``, a video whose latest
+        journal record is POISON is skipped up front (``'quarantined'``)
+        unless ``policy.retry_failed`` — the restarted-worker resume path;
+      - **categorized retries**: each failure is classified
+        TRANSIENT/POISON/FATAL; TRANSIENT and POISON get up to
+        ``policy.attempts`` total tries with exponential backoff +
+        jitter; FATAL fails immediately (retrying a config error cannot
+        help, and per-video isolation keeps the run going);
+      - **decode degradation ladder**: when ``decode_mode`` is
+        ``'parallel'``/``'process'``, each retry demotes one rung
+        (``parallel -> process -> inline``) via the fault context that
+        ``BaseExtractor.video_source`` consults;
+      - **deadline watchdog**: ``policy.deadline_s`` arms a per-attempt
+        timer that cancels the in-flight sources (DeadlineExceeded) so a
+        hung decode fails only this video;
+      - **journal record**: a terminal failure appends one
+        ``_failures.jsonl`` record; ``on_terminal_failure`` (when given)
+        receives it too, journal or not.
+
+    Default arguments (``policy=None``) reproduce the old single-attempt
+    behavior exactly. Returns ``'done'``, ``'skipped'`` (idempotent
+    already-exists), ``'quarantined'`` (journal skip) or ``'error'``.
     """
-    try:
-        result = extract_fn(video_path)
-        return "done" if result is not None else "skipped"
-    except KeyboardInterrupt:
-        raise
-    except Exception:
-        print(f"An error occurred extracting features for: {video_path}")
-        traceback.print_exc()
-        return "error"
+    from . import faults
+
+    if policy is None:
+        policy = faults.RetryPolicy()  # single attempt, no deadline
+    if journal is not None and not policy.retry_failed:
+        rec = journal.poison_record(video_path)
+        if rec is not None:
+            print(f'"{video_path}" is quarantined by {journal.path} '
+                  f'(category={rec.get("category")}, '
+                  f'attempts={rec.get("attempts")}) — skipping. '
+                  "Pass retry_failed=true to re-run it.")
+            return "quarantined"
+
+    t0 = policy.clock()
+    category = None
+    err_repr = ""
+    attempts_made = 0
+    mode = decode_mode if policy.ladder else None
+    for attempt in range(1, policy.attempts + 1):
+        attempts_made = attempt
+        override = mode if (mode is not None and mode != decode_mode) \
+            else None
+        ctx = faults.FaultContext(video_path,
+                                  deadline_s=policy.deadline_s,
+                                  decode_override=override)
+        try:
+            with ctx:
+                result = extract_fn(video_path)
+            if attempt > 1:
+                print(f'Recovered "{video_path}" on attempt '
+                      f"{attempt}/{policy.attempts}"
+                      + (f" (video_decode={mode})" if override else ""))
+            if journal is not None and policy.retry_failed \
+                    and journal.poison_record(video_path) is not None:
+                journal.resolve(video_path)  # lift the quarantine
+            return "done" if result is not None else "skipped"
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:
+            if not isinstance(e, Exception):
+                raise  # SystemExit/GeneratorExit are not video failures
+            category = faults.classify(e)
+            err_repr = f"{type(e).__name__}: {e}"
+            print(f"An error occurred extracting features for: {video_path} "
+                  f"(attempt {attempt}/{policy.attempts}, "
+                  f"category={category})")
+            traceback.print_exc()
+            if category == faults.FATAL:
+                break  # retrying a config/programming error cannot help
+            if attempt < policy.attempts:
+                next_mode = faults.demote(mode)
+                if next_mode is not None:
+                    print(f"DECODE LADDER: retrying \"{video_path}\" with "
+                          f"video_decode={next_mode} (was {mode})")
+                    mode = next_mode
+                delay = policy.backoff_delay(attempt)
+                if delay > 0:
+                    print(f"Retrying \"{video_path}\" in {delay:.2f}s ...")
+                    policy.sleep(delay)
+
+    elapsed = policy.clock() - t0
+    rec = {"video": str(video_path), "category": category,
+           "attempts": attempts_made, "error": err_repr,
+           "elapsed_s": round(float(elapsed), 3)}
+    if journal is not None:
+        rec = journal.record(video_path, category, attempts_made, err_repr,
+                             elapsed)
+    if on_terminal_failure is not None:
+        try:
+            on_terminal_failure(rec)
+        except Exception:
+            pass
+    return "error"

@@ -79,8 +79,27 @@ _TORCH_CDN = "https://download.pytorch.org/models/"
 _IG65M = "https://github.com/moabitcoin/ig65m-pytorch/releases/download/v1.0.0/"
 _VGGISH = "https://github.com/harritaylor/torchvggish/releases/download/v0.1/"
 #: the reference vendors these blobs inside its own git tree
-#: (.MISSING_LARGE_BLOBS); raw-file URLs are the only public source
-_REF_RAW = "https://github.com/habakan/video_features/raw/master/"
+#: (.MISSING_LARGE_BLOBS); raw-file URLs are the only public source.
+#: These are PICKLED torch checkpoints with no published digest, so a
+#: mutable branch ref is an arbitrary-code-execution hazard: a moved or
+#: compromised branch swaps the bytes under the same URL. Downloads
+#: therefore require an immutable commit pin (``VFT_REF_COMMIT=<sha>``,
+#: resolved at import so the URLs themselves are immutable); without one
+#: the fetcher REFUSES these files unless ``VFT_ALLOW_MUTABLE_REF=1``
+#: explicitly accepts the old master-ref behavior. Either way the first
+#: successful fetch records the file's SHA-256 into
+#: ``{weights_dir}/ref_digests.json`` and every later fetch verifies
+#: against it (trust-on-first-use), so a silently-moved blob can never
+#: replace an already-trusted one.
+_REF_COMMIT = os.environ.get("VFT_REF_COMMIT", "")
+_REF_RAW = ("https://github.com/habakan/video_features/raw/"
+            f"{_REF_COMMIT or 'master'}/")
+#: upstream filenames served from the reference repo's git tree (the
+#: unpinned-pickle set the mutable-ref refusal above applies to)
+REF_FILES = frozenset({
+    "raft-sintel.pth", "raft-kitti.pth", "i3d_rgb.pt", "i3d_flow.pt",
+    "S3D_kinetics400_torchified.pt", "pwc_net_sintel.pt",
+})
 
 #: upstream URL per filename — the same sources the reference downloads
 #: from (or, for repo-local blobs, vendors)
@@ -121,6 +140,37 @@ def expected_digest(fname: str):
     return None, None
 
 
+def _digest_registry_path() -> Path:
+    return weights_dir() / "ref_digests.json"
+
+
+def recorded_digest(fname: str) -> Optional[str]:
+    """SHA-256 recorded for ``fname`` on a previous fetch (the
+    trust-on-first-use registry for files with no published digest)."""
+    import json
+    try:
+        with open(_digest_registry_path()) as f:
+            return json.load(f).get(fname)
+    except (OSError, ValueError):
+        return None
+
+
+def record_digest(fname: str, sha256: str) -> None:
+    import json
+    path = _digest_registry_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    data[fname] = sha256
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
 def fetch_checkpoint(model_key: str) -> Optional[Path]:
     """Download ``model_key``'s upstream checkpoint into ``weights_dir()``,
     verifying the published SHA-256 while streaming. Mirrors the
@@ -142,9 +192,26 @@ def fetch_checkpoint(model_key: str) -> Optional[Path]:
             continue
         dest = wd / fname
         kind, digest = expected_digest(fname)
+        recorded = None
         if kind is None:
-            print(f"WARNING: no published digest for {fname}; downloading "
-                  f"unverified from {url}")
+            if (fname in REF_FILES and not _REF_COMMIT
+                    and os.environ.get("VFT_ALLOW_MUTABLE_REF") != "1"):
+                raise RuntimeError(
+                    f"{fname}: refusing to download a pickled checkpoint "
+                    "from the MUTABLE 'master' ref of the reference repo "
+                    "(torch.load is pickle — a moved or compromised branch "
+                    "means arbitrary code execution). Pin an immutable "
+                    "commit with VFT_REF_COMMIT=<sha>, or set "
+                    "VFT_ALLOW_MUTABLE_REF=1 to accept the risk, or drop "
+                    f"the file into {wd} yourself.")
+            recorded = recorded_digest(fname)
+            if recorded:
+                print(f"{fname}: verifying against the SHA-256 recorded on "
+                      f"first fetch ({_digest_registry_path()})")
+            else:
+                print(f"WARNING: no published digest for {fname}; "
+                      f"downloading unverified from {url} (its SHA-256 "
+                      "will be recorded for future fetches)")
         wd.mkdir(parents=True, exist_ok=True)
         # per-process unique temp name: concurrent fetchers sharing a
         # weights dir (multi-host launch) must never interleave writes
@@ -177,18 +244,24 @@ def fetch_checkpoint(model_key: str) -> Optional[Path]:
             part.unlink(missing_ok=True)
             raise
         got = h.hexdigest()
-        ok = (kind is None or
+        ok = ((kind is None and (recorded is None or got == recorded)) or
               (kind == "sha256" and got == digest) or
               (kind == "sha256-prefix" and got.startswith(digest)))
         if not ok:
             part.unlink(missing_ok=True)
+            which = (f"recorded digest (sha256:{recorded})" if kind is None
+                     else f"published digest ({kind}:{digest})")
             raise RuntimeError(
                 f"{fname}: downloaded file's SHA-256 {got[:16]}... does not "
-                f"match the published digest ({kind}:{digest}); refusing "
-                "to use it")
+                f"match the {which}; refusing to use it")
         os.replace(part, dest)  # atomic: never a torn final file
-        print(f"fetched {fname} -> {dest}"
-              + (f" [{kind} verified]" if kind else " [UNVERIFIED]"))
+        if kind is None and recorded is None:
+            # trust-on-first-use: later fetches verify against this
+            record_digest(fname, got)
+        verdict = (f" [{kind} verified]" if kind
+                   else " [recorded sha256 verified]" if recorded
+                   else f" [UNVERIFIED; sha256 {got[:16]}... recorded]")
+        print(f"fetched {fname} -> {dest}{verdict}")
         return dest
     return None
 

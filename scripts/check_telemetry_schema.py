@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Static telemetry-schema gate: emitter and JSON Schema must agree.
+
+The span record shape is declared twice on purpose — once in code
+(``telemetry/spans.py: SPAN_FIELDS``, what the emitter writes) and once
+as the checked-in contract (``telemetry/video_span.schema.json``, what
+consumers validate against). This script fails CI (quick tier,
+.github/workflows/ci.yml) when the two drift:
+
+  1. schema ``properties`` == ``SPAN_FIELDS`` (no silent new/removed
+     fields);
+  2. schema ``required`` is a subset of ``properties``;
+  3. the ``status`` enum == ``spans.STATUSES`` and the ``schema`` tag
+     enum == ``spans.SCHEMA_VERSION``;
+  4. a record actually produced by ``VideoSpan`` has exactly
+     ``SPAN_FIELDS`` keys and validates against the schema (runs the
+     same dependency-free validator the tests use,
+     telemetry/schema.py).
+
+Exit 0 = in sync; exit 1 = drift, with every violation listed.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.telemetry import schema as tschema  # noqa: E402
+from video_features_tpu.telemetry import spans  # noqa: E402
+
+
+def check() -> List[str]:
+    errs: List[str] = []
+    sch = tschema.load_span_schema()
+    props = set(sch.get("properties", {}))
+    fields = set(spans.SPAN_FIELDS)
+
+    if props != fields:
+        only_schema = sorted(props - fields)
+        only_emitter = sorted(fields - props)
+        if only_schema:
+            errs.append(f"schema-only properties (emitter never writes "
+                        f"them): {only_schema}")
+        if only_emitter:
+            errs.append(f"emitter fields missing from schema: "
+                        f"{only_emitter}")
+
+    missing_req = sorted(set(sch.get("required", [])) - props)
+    if missing_req:
+        errs.append(f"required keys not in properties: {missing_req}")
+
+    status_enum = sch.get("properties", {}).get("status", {}).get("enum")
+    if status_enum != list(spans.STATUSES):
+        errs.append(f"status enum {status_enum} != spans.STATUSES "
+                    f"{list(spans.STATUSES)}")
+
+    tag_enum = sch.get("properties", {}).get("schema", {}).get("enum")
+    if tag_enum != [spans.SCHEMA_VERSION]:
+        errs.append(f"schema tag enum {tag_enum} != "
+                    f"[{spans.SCHEMA_VERSION!r}]")
+
+    if sch.get("additionalProperties", True) is not False:
+        errs.append("schema must set additionalProperties: false "
+                    "(the record contract is closed)")
+
+    # a real emitted record: exercise every annotation path once
+    with spans.VideoSpan("schema-check.mp4",
+                         feature_type="check") as span:
+        span.annotate(status="done", attempts=2, category="TRANSIENT",
+                      error="x", decode_mode="parallel", video_fps=25.0,
+                      video_frames=10)
+        span.event("ladder", to="process")
+        span.observe_stage("decode", 0.01)
+    rec = span.record
+    if set(rec) != fields:
+        errs.append(f"emitted record keys {sorted(set(rec) ^ fields)} "
+                    "differ from SPAN_FIELDS")
+    errs.extend(tschema.validate(rec, sch))
+    return errs
+
+
+def main() -> int:
+    errs = check()
+    if errs:
+        print("telemetry schema DRIFT:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"telemetry schema OK: {len(spans.SPAN_FIELDS)} fields in sync "
+          f"({tschema.SPAN_SCHEMA_PATH})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""vft-top: render a run's telemetry artifacts into a human summary.
+
+Reads the output directory that a ``telemetry=true`` run (or fleet of
+multi-host runs sharing it) produced —
+
+    _run.json                   run manifest (one per finished host)
+    _heartbeat_{host_id}.json   per-worker liveness
+    _telemetry.jsonl            per-video span records
+    _failures.jsonl             fault journal (utils/faults.py, PR 1)
+
+— and prints what an operator actually asks: is every host alive, what
+is each one working on, where did the time go (decode vs forward vs
+write), which videos were slow or failed, and what the compile cache
+contributed. No live process required: everything is reconstructed from
+artifacts, so it works on a dead run too.
+
+    python scripts/telemetry_report.py /data/out/resnet/resnet18
+    python scripts/telemetry_report.py /data/out/... --prom /var/lib/node_exporter/vft.prom
+    python scripts/telemetry_report.py /data/out/... --slowest 10
+
+``--prom`` re-renders the manifest's metrics dump in the Prometheus text
+exposition format (node-exporter textfile collector ready).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.telemetry.heartbeat import (HEARTBEAT_GLOB,  # noqa: E402
+                                                    STALL_INTERVALS)
+from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
+from video_features_tpu.telemetry.metrics import prometheus_text  # noqa: E402
+from video_features_tpu.telemetry.recorder import SPANS_FILENAME  # noqa: E402
+from video_features_tpu.telemetry.manifest import MANIFEST_FILENAME  # noqa: E402
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 5400:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def render_manifest(man: dict) -> List[str]:
+    lines = ["== run manifest (_run.json) =="]
+    topo = man.get("topology", {})
+    lines.append(
+        f"  feature_type={man.get('feature_type')}  host={man.get('host')}"
+        f"  wall={man.get('wall_s')}s  videos/s={man.get('videos_per_s')}")
+    lines.append(
+        f"  git={str(man.get('git', {}).get('commit'))[:12]}"
+        f"{' (dirty)' if man.get('git', {}).get('dirty') else ''}"
+        f"  jax={man.get('versions', {}).get('jax')}"
+        f"  platform={topo.get('platform')}"
+        f"  devices={topo.get('n_local_devices')}/"
+        f"{topo.get('n_global_devices')}"
+        f"  process={topo.get('process_index')}/"
+        f"{topo.get('process_count')}")
+    if man.get("tally"):
+        lines.append("  tally: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(man["tally"].items())))
+    cc = man.get("compile_cache", {})
+    if cc:
+        lines.append(f"  compile cache: {cc.get('hits', 0)} hits / "
+                     f"{cc.get('misses', 0)} misses")
+    totals = man.get("stage_totals", {})
+    if totals:
+        acc = sum(v.get("s", 0.0) for v in totals.values()) or 1.0
+        lines.append("  stage totals (can overlap wall clock):")
+        for name, v in sorted(totals.items(), key=lambda kv: -kv[1]["s"]):
+            s, calls = v.get("s", 0.0), v.get("calls", 0)
+            lines.append(
+                f"    {name:<10} {s:9.3f}s {100 * s / acc:5.1f}%  "
+                f"{calls:7d} calls  {1e3 * s / max(calls, 1):8.3f} ms/call")
+    return lines
+
+
+def render_heartbeats(paths: List[str], now: float) -> List[str]:
+    lines = ["== heartbeats =="]
+    if not paths:
+        return lines + ["  (none)"]
+    for p in sorted(paths):
+        hb = _load_json(p)
+        if hb is None:
+            lines.append(f"  {os.path.basename(p)}: unreadable")
+            continue
+        age = max(0.0, now - float(hb.get("time", now)))
+        interval = float(hb.get("interval_s", 30.0)) or 30.0
+        if hb.get("final"):
+            state = "FINISHED"
+        elif age > STALL_INTERVALS * interval:
+            state = "STALLED?"
+        else:
+            state = "alive"
+        lines.append(
+            f"  {hb.get('host_id')}: {state}  age={_fmt_age(age)}  "
+            f"done={hb.get('videos_done', 0)}  "
+            f"videos/s={hb.get('videos_per_s')}  "
+            f"last={hb.get('last_video')}")
+        delta = hb.get("stage_delta") or {}
+        if delta and not hb.get("final"):
+            lines.append("    last interval: " + ", ".join(
+                f"{k}={v.get('s', 0):.2f}s/{v.get('calls', 0)}c"
+                for k, v in sorted(delta.items())))
+    return lines
+
+
+def render_spans(spans: List[dict], slowest: int) -> List[str]:
+    lines = [f"== per-video spans ({SPANS_FILENAME}: {len(spans)} records) =="]
+    if not spans:
+        return lines + ["  (none)"]
+    by_status: Dict[str, int] = {}
+    retries = 0
+    for s in spans:
+        by_status[s.get("status", "?")] = \
+            by_status.get(s.get("status", "?"), 0) + 1
+        retries += max(0, int(s.get("attempts", 1) or 1) - 1)
+    lines.append("  status: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(by_status.items()))
+        + f"; extra attempts={retries}")
+    ranked = sorted(spans, key=lambda s: -(s.get("wall_s") or 0.0))
+    lines.append(f"  slowest {min(slowest, len(ranked))}:")
+    for s in ranked[:slowest]:
+        stages = s.get("stages") or {}
+        split = " ".join(f"{k}={v.get('s', 0):.2f}s"
+                        for k, v in sorted(stages.items()))
+        lines.append(
+            f"    {s.get('wall_s', 0):8.2f}s  {s.get('status', '?'):<11} "
+            f"{s.get('video')}  [{split}]")
+    errors = [s for s in ranked if s.get("status") == "error"]
+    if errors:
+        lines.append("  failures:")
+        for s in errors[:slowest]:
+            lines.append(f"    {s.get('video')}: {s.get('category')} "
+                         f"after {s.get('attempts')} attempt(s): "
+                         f"{str(s.get('error'))[:120]}")
+    return lines
+
+
+def render_failures(path: str) -> List[str]:
+    tallies: Dict[str, int] = {}
+    for rec in read_jsonl(path):
+        cat = rec.get("category", "?")
+        tallies[cat] = tallies.get(cat, 0) + 1
+    if not tallies:
+        return []
+    return ["== fault journal (_failures.jsonl) ==",
+            "  " + ", ".join(f"{k}={v}" for k, v in sorted(tallies.items()))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("output_dir", help="a telemetry=true run's output_path")
+    ap.add_argument("--prom", metavar="FILE", default=None,
+                    help="also write a Prometheus textfile export of the "
+                         "manifest's metrics dump")
+    ap.add_argument("--slowest", type=int, default=5,
+                    help="how many slowest/failed videos to list")
+    args = ap.parse_args(argv)
+    out = args.output_dir
+    if not os.path.isdir(out):
+        print(f"error: {out} is not a directory", file=sys.stderr)
+        return 2
+
+    now = time.time()
+    lines: List[str] = [f"telemetry report: {out}"]
+    man = _load_json(os.path.join(out, MANIFEST_FILENAME))
+    if man is not None:
+        lines += render_manifest(man)
+    else:
+        lines += ["== run manifest (_run.json) ==",
+                  "  absent (run still in flight, or telemetry=false)"]
+    lines += render_heartbeats(
+        glob.glob(os.path.join(out, HEARTBEAT_GLOB)), now)
+    spans = list(read_jsonl(os.path.join(out, SPANS_FILENAME)))
+    lines += render_spans(spans, args.slowest)
+    lines += render_failures(os.path.join(out, "_failures.jsonl"))
+    print("\n".join(lines))
+
+    if args.prom:
+        dump = (man or {}).get("metrics", {"series": []})
+        with open(args.prom, "w", encoding="utf-8") as f:
+            f.write(prometheus_text(dump))
+        print(f"prometheus textfile: {args.prom} "
+              f"({len(dump.get('series', []))} series)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,386 @@
+"""Telemetry subsystem: registry, spans + schema, JSONL crash semantics,
+heartbeats, manifest, report tool, and the disabled-path zero-footprint
+contract (ISSUE 2 acceptance criteria)."""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu import telemetry
+from video_features_tpu.telemetry import jsonl as tjsonl
+from video_features_tpu.telemetry import schema as tschema
+from video_features_tpu.telemetry import spans as tspans
+from video_features_tpu.telemetry.heartbeat import (HeartbeatThread,
+                                                    heartbeat_filename)
+from video_features_tpu.telemetry.metrics import (MetricsRegistry,
+                                                  prometheus_text)
+from video_features_tpu.telemetry.recorder import TelemetryRecorder
+from video_features_tpu.utils.profiling import StageProfiler, profiler
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# -- metrics registry -------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    g.set(2.5)
+    assert g.value == 2.5
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["sum"] == pytest.approx(5.55)
+    assert [b["count"] for b in snap["buckets"]] == [1, 1]
+    assert snap["inf_count"] == 1
+
+
+def test_registry_labels_are_distinct_series_and_kinds_collide():
+    reg = MetricsRegistry()
+    reg.counter("f_total", category="POISON").inc()
+    reg.counter("f_total", category="FATAL").inc(2)
+    assert reg.counter("f_total", category="POISON").value == 1
+    assert reg.counter("f_total", category="FATAL").value == 2
+    with pytest.raises(ValueError):
+        reg.gauge("f_total")  # same name, different kind
+    dump = reg.to_dict()
+    assert len(dump["series"]) == 2
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(500):
+            reg.counter("n_total").inc()
+            reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert reg.counter("n_total").value == 2000
+    assert reg.histogram("lat", buckets=(1.0,)).count == 2000
+
+
+def test_prometheus_text_roundtrips_through_json():
+    reg = MetricsRegistry()
+    reg.counter("vft_failures_total", category="POISON").inc(3)
+    reg.histogram("vft_stage_seconds", buckets=(0.1, 1.0),
+                  stage="decode").observe(0.5)
+    dump = json.loads(json.dumps(reg.to_dict()))  # as read from _run.json
+    text = prometheus_text(dump)
+    assert 'vft_failures_total{category="POISON"} 3.0' in text
+    assert 'vft_stage_seconds_bucket{le="+Inf",stage="decode"} 1' in text
+    assert 'vft_stage_seconds_count{stage="decode"} 1' in text
+    assert "# TYPE vft_stage_seconds histogram" in text
+
+
+# -- StageProfiler drain (satellite: snapshot/reset race) -------------------
+
+def test_drain_returns_and_clears_atomically():
+    p = StageProfiler()
+    p.add("decode", 1.0)
+    p.add("decode", 0.5, n=2)
+    out = p.drain()
+    assert out == {"decode": (1.5, 3)}
+    assert p.snapshot() == {}
+    assert p.drain() == {}
+
+
+def test_drain_loses_no_updates_under_concurrency():
+    p = StageProfiler()
+    N, WORKERS = 2000, 4
+    drained = []
+    stop = threading.Event()
+
+    def flusher():
+        while not stop.is_set():
+            drained.append(p.drain())
+        drained.append(p.drain())
+
+    def producer():
+        for _ in range(N):
+            p.add("s", 1.0)
+
+    f = threading.Thread(target=flusher)
+    producers = [threading.Thread(target=producer) for _ in range(WORKERS)]
+    f.start()
+    [t.start() for t in producers]
+    [t.join() for t in producers]
+    stop.set()
+    f.join()
+    total = sum(d.get("s", (0, 0))[1] for d in drained)
+    total += p.snapshot().get("s", (0, 0))[1]
+    assert total == N * WORKERS  # snapshot()+reset() could drop some
+
+
+def test_stage_hook_times_even_when_profiler_disabled():
+    p = StageProfiler()
+    seen = []
+    p.set_hook(lambda name, dt: seen.append((name, dt)))
+    assert not p.enabled
+    with p.stage("decode"):
+        pass
+    assert len(seen) == 1 and seen[0][0] == "decode"
+    assert p.snapshot() == {}  # aggregate printing stays off
+    p.set_hook(None)
+    with p.stage("decode"):
+        pass
+    assert len(seen) == 1
+
+
+# -- span records vs the checked-in schema ----------------------------------
+
+def test_span_record_validates_against_schema():
+    with tspans.VideoSpan("/v/x.mp4", feature_type="i3d",
+                          host_id="p0-h") as span:
+        span.annotate(status="done", attempts=2, video_fps=25.0,
+                      video_frames=100, decode_mode="parallel")
+        span.event("ladder", to="process")
+        span.observe_stage("decode", 0.25)
+        span.observe_stage("decode", 0.25)
+        span.observe_stage("forward", 1.0)
+    rec = span.record
+    assert sorted(rec) == sorted(tspans.SPAN_FIELDS)
+    assert tschema.validate_span(rec) == []
+    assert rec["stages"]["decode"] == {"s": 0.5, "calls": 2}
+    assert rec["ladder_steps"] == ["process"]
+    assert json.loads(json.dumps(rec)) == rec  # JSONL-safe
+
+
+def test_span_unannotated_status_and_schema_rejections():
+    with tspans.VideoSpan("v.mp4") as span:
+        pass  # an exception path that never annotated
+    assert span.record["status"] == "error"
+    assert tschema.validate_span(span.record) == []
+    bad = dict(span.record)
+    bad["extra_key"] = 1
+    assert any("extra_key" in e for e in tschema.validate_span(bad))
+    bad2 = dict(span.record)
+    bad2["status"] = "exploded"
+    assert tschema.validate_span(bad2)
+    bad3 = dict(span.record)
+    del bad3["wall_s"]
+    assert any("wall_s" in e for e in tschema.validate_span(bad3))
+
+
+def test_schema_checker_script_passes():
+    p = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" /
+                             "check_telemetry_schema.py")],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_span_thread_propagation_via_use_span():
+    results = []
+    with tspans.VideoSpan("v.mp4") as span:
+        captured = telemetry.current_span()
+
+        def producer():
+            # no span on a fresh thread...
+            results.append(telemetry.current_span())
+            with tspans.use_span(captured):  # ...until re-installed
+                results.append(telemetry.current_span())
+
+        t = threading.Thread(target=producer)
+        t.start()
+        t.join()
+        span.annotate(status="done")
+    assert results == [None, span]
+
+
+# -- JSONL crash semantics --------------------------------------------------
+
+def test_jsonl_torn_tail_healing_on_append_and_read(tmp_path):
+    path = tmp_path / "t.jsonl"
+    tjsonl.append_jsonl(path, {"i": 1})
+    # a worker SIGKILLed mid-write leaves a torn, newline-less tail
+    with open(path, "ab") as f:
+        f.write(b'{"i": 2, "torn')
+    tjsonl.append_jsonl(path, {"i": 3})
+    recs = list(tjsonl.read_jsonl(path))
+    assert [r["i"] for r in recs] == [1, 3]  # torn record skipped, not fatal
+    assert list(tjsonl.read_jsonl(tmp_path / "absent.jsonl")) == []
+
+
+def test_write_json_atomic_leaves_no_partials(tmp_path):
+    path = tmp_path / "hb.json"
+    tjsonl.write_json_atomic(path, {"a": 1})
+    assert json.load(open(path)) == {"a": 1}
+    tjsonl.write_json_atomic(path, {"a": 2})
+    assert json.load(open(path)) == {"a": 2}
+    assert [p.name for p in tmp_path.iterdir()] == ["hb.json"]  # no temps
+
+
+# -- atomic pickle sink (satellite: write_pickle parity with write_numpy) ---
+
+def test_write_pickle_atomic_success_and_failure(tmp_path):
+    from video_features_tpu.utils import sinks
+    fpath = str(tmp_path / "v_feat.pkl")
+    sinks.write_pickle(fpath, {"x": np.arange(3)})
+    np.testing.assert_array_equal(sinks.load_pickle(fpath)["x"],
+                                  np.arange(3))
+
+    class Unpicklable:
+        def __reduce__(self):
+            raise RuntimeError("preempted mid-dump")
+
+    with pytest.raises(RuntimeError):
+        sinks.write_pickle(fpath, Unpicklable())
+    # the failed write neither tore the existing file nor left temp junk
+    np.testing.assert_array_equal(sinks.load_pickle(fpath)["x"],
+                                  np.arange(3))
+    assert [p.name for p in tmp_path.iterdir()] == ["v_feat.pkl"]
+
+
+# -- recorder end-to-end ----------------------------------------------------
+
+def test_recorder_files_heartbeat_and_manifest(tmp_path):
+    out = str(tmp_path / "out")
+    rec = TelemetryRecorder(out, run_config={"feature_type": "resnet"},
+                            feature_type="resnet", interval_s=60.0,
+                            host_id="p0-test").start()
+    try:
+        assert telemetry.active() is rec
+        with rec.video_span("/v/a.mp4") as s:
+            with profiler.stage("decode"):  # flows through the hook
+                time.sleep(0.002)
+            s.annotate(status="done")
+        with rec.video_span("/v/b.mp4") as s:
+            s.annotate(status="error", category="POISON",
+                       error="ValueError: bad", attempts=3)
+            s.event("attempt_failed", attempt=1, category="POISON")
+        telemetry.inc("vft_video_retries_total", 2)
+    finally:
+        rec.close(tally={"done": 1, "error": 1}, wall_s=2.0,
+                  failure_tallies={"POISON": 1})
+    assert telemetry.active() is None
+    assert profiler._hook is None
+
+    spans = list(tjsonl.read_jsonl(os.path.join(out, "_telemetry.jsonl")))
+    assert len(spans) == 2
+    for r in spans:
+        assert tschema.validate_span(r) == []
+    assert spans[0]["stages"]["decode"]["calls"] == 1  # hook attribution
+
+    hb = json.load(open(os.path.join(out, heartbeat_filename("p0-test"))))
+    assert hb["final"] is True
+    assert hb["videos_done"] == 2
+    assert hb["last_video"] == "/v/b.mp4"
+    assert hb["host_id"] == "p0-test"
+
+    man = json.load(open(os.path.join(out, "_run.json")))
+    assert man["schema"] == "vft.run_manifest/1"
+    assert man["tally"] == {"done": 1, "error": 1}
+    assert man["failure_tallies"] == {"POISON": 1}
+    assert man["stage_totals"]["decode"]["calls"] == 1
+    assert "jax" in man["versions"]
+    assert "platform" in man["topology"]
+    assert {"hits", "misses"} <= set(man["compile_cache"])
+    names = {s["name"] for s in man["metrics"]["series"]}
+    assert "vft_videos_total" in names
+    assert "vft_video_retries_total" in names
+
+    # the report tool renders a finished run from artifacts alone
+    prom = str(tmp_path / "vft.prom")
+    p = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "telemetry_report.py"),
+         out, "--prom", prom], capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "FINISHED" in p.stdout
+    assert "/v/b.mp4" in p.stdout
+    assert "vft_videos_total" in open(prom).read()
+
+
+def test_heartbeat_thread_ticks_and_stops_fast():
+    ticks = []
+    hb = HeartbeatThread(lambda: ticks.append(1), interval_s=0.02)
+    hb.start()
+    deadline = time.monotonic() + 5.0
+    while len(ticks) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    hb.stop()
+    assert time.monotonic() - t0 < 1.0  # stop() interrupts the wait
+    assert len(ticks) >= 2
+    with pytest.raises(ValueError):
+        HeartbeatThread(lambda: None, interval_s=0)
+
+
+# -- disabled path: zero records, zero files, no-op helpers -----------------
+
+def test_disabled_path_writes_nothing(tmp_path):
+    assert telemetry.active() is None
+    telemetry.inc("vft_anything_total")  # all helpers no-op without a run
+    telemetry.annotate(status="done")
+    telemetry.event("retry")
+    with telemetry.NOOP_SPAN as s:
+        s.annotate(status="done")
+        s.event("x")
+        s.observe_stage("decode", 1.0)
+        assert telemetry.current_span() is None  # never installed
+    with profiler.stage("decode"):
+        pass  # hookless + disabled: the no-op fast path
+    assert profiler.snapshot() == {}
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_cli_telemetry_end_to_end(tmp_path, sample_video):
+    from video_features_tpu import cli
+    out = tmp_path / "out"
+    cli.main([
+        "feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "batch_size=8", "extraction_fps=1", "allow_random_weights=true",
+        "on_extraction=save_numpy", f"output_path={out}",
+        f"tmp_path={tmp_path}/tmp", f"video_paths={sample_video}",
+        "telemetry=true", "metrics_interval_s=60",
+    ])
+    run_dir = out / "resnet" / "resnet18"
+    spans = list(tjsonl.read_jsonl(run_dir / "_telemetry.jsonl"))
+    assert len(spans) == 1
+    assert spans[0]["status"] == "done"
+    assert tschema.validate_span(spans[0]) == []
+    assert "decode" in spans[0]["stages"]  # per-video stage attribution
+    assert "forward" in spans[0]["stages"]
+    assert spans[0]["video_frames"] is not None  # extractors/base.py hook
+    man = json.load(open(run_dir / "_run.json"))
+    assert man["tally"]["done"] == 1
+    hbs = list(run_dir.glob("_heartbeat_*.json"))
+    assert len(hbs) == 1
+    assert json.load(open(hbs[0]))["final"] is True
+    p = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "telemetry_report.py"),
+         str(run_dir)], capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    # second run, telemetry off (the default): no telemetry files appear
+    out2 = tmp_path / "out2"
+    cli.main([
+        "feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "batch_size=8", "extraction_fps=1", "allow_random_weights=true",
+        "on_extraction=save_numpy", f"output_path={out2}",
+        f"tmp_path={tmp_path}/tmp2", f"video_paths={sample_video}",
+    ])
+    run_dir2 = out2 / "resnet" / "resnet18"
+    assert sorted(p.name for p in run_dir2.iterdir()) == sorted(
+        p.name for p in run_dir.iterdir()
+        if not (p.name.startswith("_heartbeat") or
+                p.name in ("_run.json", "_telemetry.jsonl")))

@@ -149,13 +149,42 @@ def main(argv: Optional[List[str]] = None) -> None:
                if args.get("on_extraction", "print") != "print" else None)
     failures: List[dict] = []  # this run's terminal records (GIL-safe append)
 
+    # Structured telemetry (telemetry=true): per-video span records in
+    # {output_path}/_telemetry.jsonl, periodic _heartbeat_{host_id}.json,
+    # and the _run.json manifest at exit. Off by default: every
+    # instrumentation point below degrades to a no-op context manager /
+    # one-global-read helper (docs/observability.md).
+    from .telemetry import NOOP_SPAN
+    recorder = None
+    if bool(args.get("telemetry", False)):
+        import socket
+        from .config import _plain
+        from .telemetry.recorder import TelemetryRecorder
+        host_id = socket.gethostname()
+        try:
+            import jax
+            host_id = f"p{jax.process_index()}-{host_id}"
+        except Exception:
+            pass
+        recorder = TelemetryRecorder(
+            args.output_path,
+            run_config=_plain(args),
+            feature_type=args.feature_type,
+            interval_s=float(args.get("metrics_interval_s") or 30.0),
+            host_id=host_id,
+        ).start()
+
     def run_one(video_path: str) -> None:
         if stop.is_set():
             return
-        status = safe_extract(extractor._extract, video_path, policy=policy,
-                              journal=journal,
-                              decode_mode=extractor.video_decode,
-                              on_terminal_failure=failures.append)
+        span_cm = (recorder.video_span(video_path)
+                   if recorder is not None else NOOP_SPAN)
+        with span_cm as span:
+            status = safe_extract(extractor._extract, video_path,
+                                  policy=policy, journal=journal,
+                                  decode_mode=extractor.video_decode,
+                                  on_terminal_failure=failures.append)
+            span.annotate(status=status)
         with tally_lock:
             tally[status] += 1
 
@@ -194,6 +223,17 @@ def main(argv: Optional[List[str]] = None) -> None:
         # us; signal.signal() can't restore those (TypeError)
         if in_main and prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)
+        if recorder is not None:
+            by_cat: dict = {}
+            for rec in failures:
+                cat = rec.get("category") or "?"
+                by_cat[cat] = by_cat.get(cat, 0) + 1
+            # close() in the finally: a SIGTERM/KeyboardInterrupt exit must
+            # still leave a manifest + final heartbeat behind — that partial
+            # record is exactly what an operator debugs the abort with
+            recorder.close(tally=dict(tally),
+                           wall_s=time.perf_counter() - t_run,
+                           failure_tallies=by_cat)
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())
@@ -216,6 +256,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     if failures and journal is not None:
         print(f"failure journal: {journal.path} (retry_failed=true re-runs "
               "quarantined videos)")
+    if recorder is not None:
+        print(f"telemetry: {recorder.manifest_path} + {recorder.spans_path} "
+              f"(render with scripts/telemetry_report.py "
+              f"{args.output_path})")
     if profiler.enabled:
         print(profiler.summary(f"profile: {args.feature_type} x "
                                f"{len(video_paths)} videos"))

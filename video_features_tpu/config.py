@@ -247,6 +247,15 @@ def sanity_check(args: Config) -> None:
         raise ValueError(f"video_deadline_s={vd!r}: need a float > 0 "
                          "(or null to disable the per-video deadline)")
 
+    # telemetry keys (telemetry/ subsystem): same launch-time validation
+    tel = args.get("telemetry", False)
+    if not isinstance(tel, bool):
+        raise ValueError(f"telemetry={tel!r}: expected true or false")
+    mi = args.get("metrics_interval_s")
+    if mi is not None and float(mi) <= 0:
+        raise ValueError(f"metrics_interval_s={mi!r}: need a float > 0 "
+                         "(the heartbeat/metrics flush period)")
+
     fps_mode = args.get("fps_mode", "select") or "select"
     if fps_mode not in ("select", "reencode"):
         raise ValueError(

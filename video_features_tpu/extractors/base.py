@@ -89,6 +89,19 @@ class BaseExtractor:
         src = cls(video_path, **kwargs)
         if ctx is not None:
             ctx.register(src)
+        # telemetry (no-ops without an active span): the source's probed
+        # properties give the span its fps/frame-count fields, and the
+        # event records which decode class actually served each attempt
+        # (the ladder may have demoted it)
+        from .. import telemetry
+        if telemetry.current_span() is not None:
+            try:
+                n_frames = len(src)
+            except Exception:
+                n_frames = None
+            telemetry.annotate(video_fps=getattr(src, "fps", None),
+                               video_frames=n_frames)
+            telemetry.event("source", mode=mode, cls=type(src).__name__)
         return src
 
     def _data_mesh(self):

@@ -57,6 +57,24 @@ def get_mesh(n_devices: Optional[int] = None,
     return Mesh(mesh_devs, axis_names)
 
 
+def mesh_topology() -> dict:
+    """JSON-safe device/mesh topology snapshot for the run manifest
+    (telemetry/manifest.py): what hardware this process actually saw,
+    recorded so a perf number in ``_run.json`` is interpretable months
+    later. Uses the same addressable-device view as :func:`get_mesh`."""
+    devs = jax.local_devices()
+    kinds = sorted({getattr(d, "device_kind", "?") for d in devs})
+    return {
+        "platform": devs[0].platform if devs else "none",
+        "device_kinds": kinds,
+        "n_local_devices": len(devs),
+        "n_global_devices": jax.device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "default_mesh_axes": {"data": len(devs)},
+    }
+
+
 def local_shard_of_list(items: Sequence[str], host_id: Optional[int] = None,
                         num_hosts: Optional[int] = None) -> List[str]:
     """Deterministic item->host assignment: ``md5(stem) % num_hosts``.

@@ -1,0 +1,78 @@
+"""Structured telemetry: metrics, per-video spans, manifest, heartbeats.
+
+This package subsumes and extends the interactive stage timer
+(utils/profiling.py) into the observability layer the ROADMAP's
+production fleet needs — operators answer "which worker is slow, which
+video stalled, is the chip or the host the bottleneck, and what did
+last night's run actually do" from *artifacts*, not a live terminal:
+
+  ===========================  ============================================
+  ``_telemetry.jsonl``         one span record per video (telemetry/spans.py,
+                               schema in ``video_span.schema.json``)
+  ``_run.json``                run manifest at exit (telemetry/manifest.py)
+  ``_heartbeat_{host_id}.json``  periodic per-worker liveness
+                               (telemetry/heartbeat.py)
+  metrics registry             counters/gauges/fixed-bucket histograms
+                               (telemetry/metrics.py), dumped into the
+                               manifest + Prometheus export via
+                               ``scripts/telemetry_report.py``
+  ===========================  ============================================
+
+Enabled by ``telemetry=true`` (+ ``metrics_interval_s=``) on the CLI;
+cli.py owns the :class:`~.recorder.TelemetryRecorder` lifecycle. The
+instrumentation points in utils/sinks.py, utils/faults.py, utils/io.py
+and extractors/base.py call the module-level helpers below, which cost
+one global (or thread-local) read when telemetry is off — the same
+permanently-in-place, near-zero-disabled-overhead discipline as
+``profiler.stage``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .spans import (NOOP_SPAN, NoopSpan, SPAN_FIELDS, STATUSES,  # noqa: F401
+                    VideoSpan, current_span, use_span)
+from .metrics import MetricsRegistry, prometheus_text  # noqa: F401
+
+#: the active run's TelemetryRecorder, or None (telemetry disabled)
+_active = None
+
+
+def _set_active(recorder) -> None:
+    global _active
+    _active = recorder
+
+
+def active():
+    """The active :class:`~.recorder.TelemetryRecorder`, if any."""
+    return _active
+
+
+# -- cheap instrumentation helpers (no-ops when telemetry is off) -----------
+
+def inc(name: str, n: float = 1.0, **labels: Any) -> None:
+    """Increment a counter on the active recorder's registry."""
+    r = _active
+    if r is not None:
+        r.registry.counter(name, **labels).inc(n)
+
+
+def observe(name: str, value: float, buckets=None, **labels: Any) -> None:
+    """Observe into a histogram on the active recorder's registry."""
+    r = _active
+    if r is not None:
+        r.registry.histogram(name, buckets=buckets, **labels).observe(value)
+
+
+def annotate(**kw: Any) -> None:
+    """Set attributes on this thread's current video span, if any."""
+    s = current_span()
+    if s is not None:
+        s.annotate(**kw)
+
+
+def event(kind: str, **kw: Any) -> None:
+    """Append a timeline event to this thread's current video span."""
+    s = current_span()
+    if s is not None:
+        s.event(kind, **kw)

@@ -1,0 +1,75 @@
+"""Multi-host heartbeats: periodic liveness files in the shared output dir.
+
+Each worker writes ``{output_path}/_heartbeat_{host_id}.json`` every
+``metrics_interval_s`` seconds (atomic replace, telemetry/jsonl.py), so
+a coordinator — or an operator running ``scripts/telemetry_report.py``
+— can tell a slow host from a dead one without SSH: a heartbeat older
+than ~3 intervals means the worker stalled or died, and its ``last_video``
+names the suspect input. This is the observability half of the
+multi-host story whose work-partitioning half is
+``parallel/mesh.py:local_shard_of_list`` — hosts never talk to each
+other, they only co-own an output directory.
+
+The writer thread is a daemon with an injectable clock/interval so tests
+never sleep; ticks call back into the recorder, which owns the file
+contents (telemetry/recorder.py ``build_heartbeat``).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Optional
+
+HEARTBEAT_PREFIX = "_heartbeat_"
+HEARTBEAT_GLOB = HEARTBEAT_PREFIX + "*.json"
+
+#: a heartbeat older than this many intervals marks the host STALLED
+STALL_INTERVALS = 3.0
+
+
+def heartbeat_filename(host_id: str) -> str:
+    """``_heartbeat_{host_id}.json`` with the id sanitized for the
+    filesystem (host ids embed hostnames)."""
+    safe = re.sub(r"[^A-Za-z0-9._-]+", "-", str(host_id))
+    return f"{HEARTBEAT_PREFIX}{safe}.json"
+
+
+class HeartbeatThread:
+    """Fires ``tick()`` every ``interval_s`` until :meth:`stop`.
+
+    ``Event.wait(interval)`` (not ``sleep``) so stop() interrupts a wait
+    immediately — worker shutdown must not dangle for up to a full
+    metrics interval.
+    """
+
+    def __init__(self, tick: Callable[[], None], interval_s: float) -> None:
+        if float(interval_s) <= 0:
+            raise ValueError(
+                f"metrics_interval_s={interval_s}: need > 0")
+        self._tick = tick
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="vft-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._tick()
+            except Exception:
+                # liveness reporting must never kill (or be killed by)
+                # the extraction it observes; the next tick retries
+                pass
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None

@@ -1,0 +1,93 @@
+"""Crash-safe JSON file primitives shared by every telemetry artifact.
+
+Two disciplines, both inherited from the fault journal (utils/faults.py,
+PR 1) and now factored here so `_telemetry.jsonl`, `_failures.jsonl`,
+`_run.json` and the heartbeat files all behave identically under
+preemption:
+
+  - **atomic append** (:func:`append_jsonl`): one ``os.write`` on an
+    ``O_APPEND`` fd per record, with torn-tail healing — a worker
+    SIGKILLed mid-write leaves a line with no newline, and the next
+    append prepends one so only the already-torn record is sacrificed.
+    Concurrent shard workers sharing the output dir never interleave
+    partial lines (records stay well under PIPE_BUF).
+  - **atomic replace** (:func:`write_json_atomic`): temp file in the
+    same directory + flush + fsync + ``os.replace``, the same contract
+    as feature files (utils/sinks.py) — a reader can never observe a
+    half-written manifest or heartbeat.
+
+Readers (:func:`read_jsonl`) skip corrupt lines instead of failing:
+telemetry is an observation channel, never a lock.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Iterator, Union
+
+PathLike = Union[str, os.PathLike]
+
+
+def append_jsonl(path: PathLike, rec: dict) -> None:
+    """Append one record as a single atomic ``os.write``, healing a torn
+    tail left by a previously killed writer."""
+    path = str(path)
+    line = (json.dumps(rec, sort_keys=True) + "\n").encode()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        try:
+            if os.fstat(fd).st_size > 0:
+                with open(path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        line = b"\n" + line
+        except OSError:
+            pass
+        os.write(fd, line)
+    finally:
+        os.close(fd)
+
+
+def read_jsonl(path: PathLike) -> Iterator[dict]:
+    """Yield every parseable dict record; corrupt lines (torn appends from
+    a killed worker) are skipped, never fatal. A missing file yields
+    nothing."""
+    try:
+        f = open(str(path), encoding="utf-8", errors="replace")
+    except OSError:
+        return
+    with f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def write_json_atomic(path: PathLike, obj: dict, indent: int = 2) -> None:
+    """Write ``obj`` as JSON via temp-file + fsync + ``os.replace`` so a
+    reader (or a resumed worker) can never see a partial document."""
+    path = str(path)
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(obj, f, indent=indent, sort_keys=True, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,260 @@
+"""TelemetryRecorder: the run-scoped owner of every telemetry channel.
+
+One recorder per CLI run (cli.py constructs it when ``telemetry=true``):
+
+  - owns the :class:`~.metrics.MetricsRegistry` and installs the stage
+    hook on the process-global ``profiler`` (utils/profiling.py), so the
+    decode/forward/write context managers that already instrument the
+    pipelines feed latency histograms + per-video spans with no new call
+    sites in the hot loops;
+  - mints :class:`~.spans.VideoSpan`\\ s and appends their records to
+    ``{output_path}/_telemetry.jsonl``;
+  - runs the heartbeat thread (telemetry/heartbeat.py) and writes this
+    host's ``_heartbeat_{host_id}.json``, including the per-interval
+    stage delta obtained from ``StageProfiler.drain()`` — the atomic
+    snapshot+reset that replaces the racy snapshot-then-reset pair;
+  - counts XLA compile-cache hits/misses via ``jax.monitoring`` event
+    listeners (installed once per process; recorders read deltas);
+  - writes the run manifest (telemetry/manifest.py) at :meth:`close`.
+
+When no recorder is active every instrumentation point in the codebase
+is a constant-time no-op: the module-level helpers in
+``telemetry/__init__.py`` read one global, the profiler hook is None,
+and cli.py hands out ``NOOP_SPAN``.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.profiling import StageProfiler, profiler
+from . import jsonl, manifest
+from .heartbeat import HeartbeatThread, heartbeat_filename
+from .metrics import FPS_BUCKETS, LATENCY_BUCKETS, MetricsRegistry
+from .spans import VideoSpan, current_span
+
+SPANS_FILENAME = "_telemetry.jsonl"
+
+# -- process-wide compile-cache event counts --------------------------------
+# jax.monitoring listeners cannot be unregistered individually, so they are
+# installed once and recorders read deltas against a start-of-run baseline.
+
+_mon_lock = threading.Lock()
+_mon_counts: Dict[str, int] = {}
+_mon_installed = False
+
+
+def _bump_mon(event: str) -> None:
+    with _mon_lock:
+        _mon_counts[event] = _mon_counts.get(event, 0) + 1
+
+
+def _install_monitoring() -> None:
+    global _mon_installed
+    with _mon_lock:
+        if _mon_installed:
+            return
+        _mon_installed = True
+    try:
+        from jax import monitoring
+
+        def on_event(event: str, **kw) -> None:
+            if "compilation_cache" in event:
+                _bump_mon(event)
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if "compilation_cache" in event:
+                _bump_mon(event)
+
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:
+        pass  # telemetry degrades, extraction does not
+
+
+def _mon_snapshot() -> Dict[str, int]:
+    with _mon_lock:
+        return dict(_mon_counts)
+
+
+def compile_cache_summary(baseline: Dict[str, int]) -> Dict[str, int]:
+    """Delta of compile-cache events since ``baseline``, folded into
+    hit/miss totals plus the raw per-event counts."""
+    now = _mon_snapshot()
+    delta = {k: now.get(k, 0) - baseline.get(k, 0) for k in now
+             if now.get(k, 0) != baseline.get(k, 0)}
+    out: Dict[str, int] = {"hits": 0, "misses": 0}
+    for event, n in delta.items():
+        if event.endswith("cache_hits"):
+            out["hits"] += n
+        elif event.endswith("cache_misses"):
+            out["misses"] += n
+        out[event] = n
+    return out
+
+
+class TelemetryRecorder:
+    """Run-scoped telemetry: construct, :meth:`start`, hand out spans,
+    :meth:`close` in a ``finally``."""
+
+    def __init__(self, output_path: str, *,
+                 run_config: Optional[dict] = None,
+                 feature_type: Optional[str] = None,
+                 interval_s: float = 30.0,
+                 host_id: Optional[str] = None) -> None:
+        self.output_path = str(output_path)
+        self.run_config = run_config
+        self.feature_type = feature_type
+        self.interval_s = float(interval_s)
+        self.host_id = host_id or socket.gethostname()
+        self.registry = MetricsRegistry()
+        self.spans_path = os.path.join(self.output_path, SPANS_FILENAME)
+        self.heartbeat_path = os.path.join(
+            self.output_path, heartbeat_filename(self.host_id))
+        self.manifest_path = os.path.join(
+            self.output_path, manifest.MANIFEST_FILENAME)
+        # run-long stage totals (manifest) + per-interval delta (heartbeat,
+        # drained atomically each tick)
+        self._run_stages = StageProfiler()
+        self._delta_stages = StageProfiler()
+        self._hb = HeartbeatThread(self._tick, self.interval_s)
+        self._state_lock = threading.Lock()
+        self._last_video: Optional[str] = None
+        self._status_counts: Dict[str, int] = {}
+        self._t0 = time.perf_counter()
+        self._start_time = time.time()
+        self._mon_baseline: Dict[str, int] = {}
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "TelemetryRecorder":
+        from . import _set_active
+        _install_monitoring()
+        self._mon_baseline = _mon_snapshot()
+        os.makedirs(self.output_path, exist_ok=True)
+        _set_active(self)
+        profiler.set_hook(self._observe_stage)
+        self.write_heartbeat()  # liveness visible before the first video
+        self._hb.start()
+        self._started = True
+        return self
+
+    def close(self, *, tally: Optional[Dict[str, int]] = None,
+              wall_s: Optional[float] = None,
+              failure_tallies: Optional[Dict[str, int]] = None) -> None:
+        """Stop the heartbeat thread, write a final heartbeat and the run
+        manifest. Idempotent; never raises into the caller's finally."""
+        if self._closed:
+            return
+        self._closed = True
+        from . import _set_active
+        self._hb.stop()
+        profiler.set_hook(None)
+        _set_active(None)
+        try:
+            self.write_heartbeat(final=True)
+            jsonl.write_json_atomic(self.manifest_path, self.build_manifest(
+                tally=tally, wall_s=wall_s, failure_tallies=failure_tallies))
+        except Exception as e:
+            print(f"telemetry: failed to write {self.manifest_path}: "
+                  f"{type(e).__name__}: {e}")
+
+    # -- spans --------------------------------------------------------------
+    def video_span(self, video: str) -> VideoSpan:
+        return VideoSpan(video, recorder=self,
+                         feature_type=self.feature_type,
+                         host_id=self.host_id)
+
+    def emit_span(self, record: dict) -> None:
+        jsonl.append_jsonl(self.spans_path, record)
+        status = record.get("status", "?")
+        self.registry.counter("vft_videos_total", status=status).inc()
+        self.registry.histogram("vft_video_wall_seconds",
+                                buckets=LATENCY_BUCKETS).observe(
+                                    record.get("wall_s") or 0.0)
+        frames, wall = record.get("video_frames"), record.get("wall_s")
+        if frames and wall:
+            self.registry.histogram("vft_video_processed_fps",
+                                    buckets=FPS_BUCKETS).observe(
+                                        frames / wall)
+        with self._state_lock:
+            self._last_video = record.get("video")
+            self._status_counts[status] = \
+                self._status_counts.get(status, 0) + 1
+
+    # -- stage hook (installed on the global profiler) -----------------------
+    def _observe_stage(self, name: str, dt: float) -> None:
+        self.registry.histogram("vft_stage_seconds", buckets=LATENCY_BUCKETS,
+                                stage=name).observe(dt)
+        self._run_stages.add(name, dt)
+        self._delta_stages.add(name, dt)
+        span = current_span()
+        if span is not None:
+            span.observe_stage(name, dt)
+
+    # -- heartbeats ----------------------------------------------------------
+    def _tick(self) -> None:
+        self.write_heartbeat()
+
+    def build_heartbeat(self, final: bool = False) -> dict:
+        uptime = time.perf_counter() - self._t0
+        with self._state_lock:
+            status_counts = dict(self._status_counts)
+            last_video = self._last_video
+        done = sum(status_counts.values())
+        vps = round(status_counts.get("done", 0) / uptime, 4) if uptime \
+            else 0.0
+        self.registry.gauge("vft_videos_per_second").set(vps)
+        self.registry.gauge("vft_uptime_seconds").set(round(uptime, 3))
+        # drain(): atomic snapshot+reset — the per-interval stage delta a
+        # scraper can turn into rates without double counting
+        delta = {k: {"s": round(v[0], 6), "calls": v[1]}
+                 for k, v in self._delta_stages.drain().items()}
+        return {
+            "schema": "vft.heartbeat/1",
+            "host": socket.gethostname(),
+            "host_id": self.host_id,
+            "pid": os.getpid(),
+            "feature_type": self.feature_type,
+            "time": round(time.time(), 3),
+            "started_time": round(self._start_time, 3),
+            "uptime_s": round(uptime, 3),
+            "interval_s": self.interval_s,
+            "final": bool(final),
+            "videos": status_counts,
+            "videos_done": done,
+            "videos_per_s": vps,
+            "last_video": last_video,
+            "stage_delta": delta,
+        }
+
+    def write_heartbeat(self, final: bool = False) -> None:
+        jsonl.write_json_atomic(self.heartbeat_path,
+                                self.build_heartbeat(final=final))
+
+    # -- manifest ------------------------------------------------------------
+    def build_manifest(self, *, tally: Optional[Dict[str, int]] = None,
+                       wall_s: Optional[float] = None,
+                       failure_tallies: Optional[Dict[str, int]] = None
+                       ) -> dict:
+        with self._state_lock:
+            tally = dict(tally if tally is not None else self._status_counts)
+        stage_totals = {k: {"s": round(v[0], 6), "calls": v[1]}
+                        for k, v in self._run_stages.snapshot().items()}
+        return manifest.build_manifest(
+            run_config=self.run_config,
+            feature_type=self.feature_type,
+            host_id=self.host_id,
+            started_time=round(self._start_time, 3),
+            wall_s=wall_s if wall_s is not None
+            else time.perf_counter() - self._t0,
+            tally=tally,
+            failure_tallies=failure_tallies,
+            stage_totals=stage_totals,
+            metrics_dump=self.registry.to_dict(),
+            compile_cache=compile_cache_summary(self._mon_baseline),
+        )

@@ -243,6 +243,8 @@ class FaultContext:
         print(f"WATCHDOG: {self.video_path} exceeded video_deadline_s="
               f"{self.deadline_s}; killing its in-flight decode "
               f"({len(sources)} source(s))")
+        from .. import telemetry
+        telemetry.inc("vft_deadline_expirations_total")
         for s in sources:
             self._cancel_source(s)
 
@@ -303,6 +305,8 @@ class FailureJournal:
             "time": time.time(),
         }
         self._append(rec)
+        from .. import telemetry
+        telemetry.inc("vft_failures_total", category=str(category))
         return rec
 
     def resolve(self, video: str) -> None:
@@ -312,26 +316,11 @@ class FailureJournal:
                       "host": socket.gethostname(), "time": time.time()})
 
     def _append(self, rec: dict) -> None:
-        line = (json.dumps(rec, sort_keys=True) + "\n").encode()
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        fd = os.open(self.path,
-                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        try:
-            # heal a torn tail: a worker SIGKILLed mid-write leaves a line
-            # with no newline, which would otherwise swallow THIS record
-            # into the corrupt line. Prepending one sacrifices only the
-            # already-torn record (load() skips it).
-            try:
-                if os.fstat(fd).st_size > 0:
-                    with open(self.path, "rb") as f:
-                        f.seek(-1, os.SEEK_END)
-                        if f.read(1) != b"\n":
-                            line = b"\n" + line
-            except OSError:
-                pass
-            os.write(fd, line)
-        finally:
-            os.close(fd)
+        # single atomic O_APPEND write + torn-tail healing, shared with
+        # _telemetry.jsonl (telemetry/jsonl.py — factored out of this
+        # class so every JSONL artifact has identical crash semantics)
+        from ..telemetry.jsonl import append_jsonl
+        append_jsonl(self.path, rec)
         with self._lock:
             self._cache = None  # force re-read after our own write
 

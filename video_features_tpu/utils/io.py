@@ -947,6 +947,11 @@ class Prefetcher:
     def __init__(self, iterable, depth: int = 2):
         self.iterable = iterable
         self.depth = depth
+        # capture the constructing thread's telemetry span (if any): the
+        # producer thread re-installs it so its decode-stage timings still
+        # attribute to the right video's span (telemetry/spans.py)
+        from ..telemetry import current_span
+        self._span = current_span()
 
     def __iter__(self):
         import queue as _queue
@@ -966,10 +971,12 @@ class Prefetcher:
             return False
 
         def produce():
+            from ..telemetry import use_span
             try:
-                for item in self.iterable:
-                    if not put_until_stopped(item):
-                        return
+                with use_span(self._span):
+                    for item in self.iterable:
+                        if not put_until_stopped(item):
+                            return
                 put_until_stopped(self._DONE)
             except BaseException as e:  # re-raised consumer-side
                 put_until_stopped(e)

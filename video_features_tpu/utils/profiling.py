@@ -19,13 +19,18 @@ Here profiling is a first-class subsystem:
   - ``profile_trace_dir=/path`` additionally captures a ``jax.profiler``
     trace (one per run) viewable in TensorBoard/Perfetto, with device-side
     op timelines.
+  - ``telemetry=true`` (telemetry/) rides the SAME ``profiler.stage`` call
+    sites: the recorder installs :meth:`StageProfiler.set_hook`, which
+    feeds latency histograms and per-video spans without new code in the
+    hot loops. Stages are timed whenever either consumer (aggregate
+    printing or the hook) is active.
 """
 from __future__ import annotations
 
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 
 class StageProfiler:
@@ -37,10 +42,18 @@ class StageProfiler:
         self._lock = threading.Lock()  # decode runs in the Prefetcher thread
         self._times: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
+        self._hook: Optional[Callable[[str, float], None]] = None
+
+    def set_hook(self, hook: Optional[Callable[[str, float], None]]) -> None:
+        """Install (or clear, with None) a per-observation callback
+        ``hook(stage_name, seconds)`` — the telemetry recorder's feed.
+        Timing happens whenever ``enabled`` OR a hook is present."""
+        self._hook = hook
 
     @contextmanager
     def stage(self, name: str) -> Iterator[None]:
-        if not self.enabled:
+        hook = self._hook
+        if not self.enabled and hook is None:
             yield
             return
         t0 = time.perf_counter()
@@ -48,28 +61,60 @@ class StageProfiler:
             yield
         finally:
             dt = time.perf_counter() - t0
-            with self._lock:
-                self._times[name] += dt
-                self._counts[name] += 1
+            if self.enabled:
+                with self._lock:
+                    self._times[name] += dt
+                    self._counts[name] += 1
+            if hook is not None:
+                try:
+                    hook(name, dt)
+                except Exception:
+                    pass  # observability must never fail the pipeline
+
+    def add(self, name: str, dt: float, n: int = 1) -> None:
+        """Accumulate an externally-timed observation (the telemetry
+        recorder's delta/total accumulators use this; ``enabled`` gates
+        only the context-manager path)."""
+        with self._lock:
+            self._times[name] += dt
+            self._counts[name] += n
 
     def snapshot(self) -> Dict[str, Tuple[float, int]]:
-        return {k: (self._times[k], self._counts[k]) for k in self._times}
+        with self._lock:
+            return {k: (self._times[k], self._counts[k])
+                    for k in self._times}
 
     def reset(self) -> None:
-        self._times.clear()
-        self._counts.clear()
+        with self._lock:
+            self._times.clear()
+            self._counts.clear()
+
+    def drain(self) -> Dict[str, Tuple[float, int]]:
+        """Atomic snapshot+reset under ONE lock acquisition.
+
+        The old ``snapshot()``-then-``reset()`` pair could lose a stage
+        update landing between the two calls (each took the lock
+        independently); flushers that turn accumulated stage time into
+        per-interval deltas (telemetry/recorder.py heartbeats) must use
+        this instead."""
+        with self._lock:
+            out = {k: (self._times[k], self._counts[k])
+                   for k in self._times}
+            self._times.clear()
+            self._counts.clear()
+            return out
 
     def summary(self, title: str = "profile") -> str:
         """Stages can overlap in wall time (decode runs in the Prefetcher
         thread while forward runs on the main thread), so the accounted
         total can exceed wall clock — that overlap is the pipeline working
         as designed."""
-        if not self._times:
+        snap = self.snapshot()
+        if not snap:
             return f"[{title}] no stages recorded"
-        total = sum(self._times.values())
+        total = sum(t for t, _ in snap.values())
         lines = [f"[{title}] total accounted: {total:.3f}s"]
-        for name, t in sorted(self._times.items(), key=lambda kv: -kv[1]):
-            n = self._counts[name]
+        for name, (t, n) in sorted(snap.items(), key=lambda kv: -kv[1][0]):
             lines.append(
                 f"  {name:<10} {t:8.3f}s  {100 * t / total:5.1f}%  "
                 f"{n:6d} calls  {1e3 * t / max(n, 1):8.3f} ms/call")

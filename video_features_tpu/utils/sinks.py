@@ -53,8 +53,27 @@ def load_pickle(fpath):
 
 
 def write_pickle(fpath, value):
-    with open(fpath, "wb") as f:
-        pickle.dump(value, f)
+    # same temp-file + fsync + atomic-rename discipline as write_numpy: a
+    # preempted worker must never leave a torn .pkl that load_pickle would
+    # half-read (or that poisons is_already_exist's resume check forever)
+    import tempfile
+    d = os.path.dirname(fpath) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(fpath) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(value, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fpath)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def is_already_exist(on_extraction: str, output_path: str, video_path: str,
@@ -167,9 +186,11 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
     already-exists), ``'quarantined'`` (journal skip) or ``'error'``.
     """
     from . import faults
+    from .. import telemetry
 
     if policy is None:
         policy = faults.RetryPolicy()  # single attempt, no deadline
+    telemetry.annotate(decode_mode=decode_mode)
     if journal is not None and not policy.retry_failed:
         rec = journal.poison_record(video_path)
         if rec is not None:
@@ -177,6 +198,9 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                   f'(category={rec.get("category")}, '
                   f'attempts={rec.get("attempts")}) — skipping. '
                   "Pass retry_failed=true to re-run it.")
+            telemetry.inc("vft_quarantine_skips_total")
+            telemetry.event("quarantine_skip",
+                            category=rec.get("category"))
             return "quarantined"
 
     t0 = policy.clock()
@@ -198,6 +222,8 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                 print(f'Recovered "{video_path}" on attempt '
                       f"{attempt}/{policy.attempts}"
                       + (f" (video_decode={mode})" if override else ""))
+                telemetry.inc("vft_video_recoveries_total")
+            telemetry.annotate(attempts=attempt)
             if journal is not None and policy.retry_failed \
                     and journal.poison_record(video_path) is not None:
                 journal.resolve(video_path)  # lift the quarantine
@@ -213,6 +239,8 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                   f"(attempt {attempt}/{policy.attempts}, "
                   f"category={category})")
             traceback.print_exc()
+            telemetry.event("attempt_failed", attempt=attempt,
+                            category=category)
             if category == faults.FATAL:
                 break  # retrying a config/programming error cannot help
             if attempt < policy.attempts:
@@ -220,13 +248,18 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                 if next_mode is not None:
                     print(f"DECODE LADDER: retrying \"{video_path}\" with "
                           f"video_decode={next_mode} (was {mode})")
+                    telemetry.event("ladder", to=next_mode)
+                    telemetry.inc("vft_decode_demotions_total")
                     mode = next_mode
                 delay = policy.backoff_delay(attempt)
+                telemetry.inc("vft_video_retries_total")
                 if delay > 0:
                     print(f"Retrying \"{video_path}\" in {delay:.2f}s ...")
                     policy.sleep(delay)
 
     elapsed = policy.clock() - t0
+    telemetry.annotate(attempts=attempts_made, category=category,
+                       error=err_repr)
     rec = {"video": str(video_path), "category": category,
            "attempts": attempts_made, "error": err_repr,
            "elapsed_s": round(float(elapsed), 3)}

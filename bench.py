@@ -366,6 +366,55 @@ def bench_pipeline(n_copies: int = 8) -> dict:
             "clips": clips, "wall_s": wall}
 
 
+def bench_shared_decode(families=("resnet", "clip", "s3d"),
+                        n_copies: int = 4) -> dict:
+    """Multi-family sharing ratio: N sequential single-family CLI runs
+    (N full decode passes) vs ONE shared-decode run of the same families
+    over the same corpus (parallel/fanout.py), fresh output dirs, each
+    variant warmed untimed first. The ratio is recorded per bench round
+    so decode-bound regressions in the fan-out path show up next to the
+    device numbers; `scripts/throughput.py --families a,b` runs the
+    longer interleaved-median version of the same A/B."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the shared-decode bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_share_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_share{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(feature_type: str, out: str, videos) -> float:
+            argv = [f"feature_type={feature_type}", f"output_path={td}/{out}",
+                    f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(videos) + "]"] + base
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        for fam in families:  # untimed warmups (weights, compiles, cache)
+            run(fam, f"warm_{fam}", vids[:1])
+        run(",".join(families), "warm_multi", vids[:1])
+        seq = sum(run(fam, f"seq_{fam}", vids) for fam in families)
+        shared = run(",".join(families), "shared", vids)
+    return {"families": list(families), "n_copies": n_copies,
+            "sequential_s": round(seq, 2), "shared_s": round(shared, 2),
+            "sharing_ratio": round(seq / shared, 2)}
+
+
 def bench_i3d_torch(stack: int = I3D_STACK) -> float:
     """The full reference-shaped stack unit in torch on this host's CPU:
     RAFT flow on the frame pairs PLUS both I3D tower forwards (all classes
@@ -845,6 +894,27 @@ def main() -> None:
     except Exception as e:
         print(f"WARNING: pipeline bench failed: {type(e).__name__}: {e}",
               file=__import__("sys").stderr)
+    # decode-once fan-out: N families for ~1x decode; recorded every
+    # round so the sharing ratio is tracked alongside the device numbers
+    try:
+        share = bench_shared_decode()
+        metrics.append({
+            "metric": "multi-family shared-decode sharing ratio "
+                      f"({'+'.join(share['families'])})",
+            "value": share["sharing_ratio"],
+            "unit": "x vs sequential single-family runs",
+            "vs_baseline": None,
+            "sequential_s": share["sequential_s"],
+            "shared_s": share["shared_s"],
+            "note": f"{share['n_copies']}x sample, extraction_fps=4, "
+                    "fresh outputs, warmed; decode-bound hosts approach "
+                    "Nx — scripts/throughput.py --families runs the "
+                    "interleaved-median A/B (docs/performance.md "
+                    "'Decode once, extract many')",
+        })
+    except Exception as e:
+        print(f"WARNING: shared-decode bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
 
     # Full-fidelity record (notes, baselines, every row) goes to a repo
     # file: the driver keeps only the LAST 2,000 chars of stdout, which in

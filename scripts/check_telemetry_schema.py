@@ -70,7 +70,7 @@ def check() -> List[str]:
                          feature_type="check") as span:
         span.annotate(status="done", attempts=2, category="TRANSIENT",
                       error="x", decode_mode="parallel", video_fps=25.0,
-                      video_frames=10)
+                      video_frames=10, decode_shared_ms=12.5)
         span.event("ladder", to="process")
         span.observe_stage("decode", 0.01)
     rec = span.record

@@ -20,6 +20,13 @@ Usage (any main.py key=value passes through):
     python scripts/throughput.py feature_type=r21d --repeat 4 -- \
         resize=host :: resize=device
 
+    # shared-decode A/B: sequential single-family runs vs ONE
+    # decode-once multi-family run, interleaved per round, medians +
+    # bit-identity verdict (docs/performance.md "Decode once, extract
+    # many"); remaining key=value args are shared config for both arms
+    python scripts/throughput.py --families resnet,clip,s3d --rounds 3 \
+        device=cpu extraction_fps=4 allow_random_weights=true
+
 Prints one JSON line per knob set:
     {"config": ..., "videos": N, "seconds": S, "videos_per_s": ...,
      "frames_per_s": ...}
@@ -43,6 +50,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 SAMPLE = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+if not SAMPLE.exists():  # hosts without the reference mount: the
+    # vendored synthesized twin (same nominal fps/frames/geometry)
+    SAMPLE = (Path(__file__).resolve().parent.parent / "tests" / "assets"
+              / "v_synth_sample.mp4")
 
 
 def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
@@ -84,12 +95,111 @@ def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
     return result
 
 
+def _timed_run(base_args, videos, outdir: Path, tmpdir: Path) -> float:
+    """One timed CLI pass into a FRESH output dir (no warmup here — the
+    --families A/B warms each variant once up front)."""
+    from video_features_tpu.cli import main as cli_main
+    t0 = time.perf_counter()
+    cli_main(list(base_args) + [
+        "on_extraction=save_numpy", f"output_path={outdir}",
+        f"tmp_path={tmpdir}", f"video_paths=[{','.join(videos)}]",
+    ])
+    return time.perf_counter() - t0
+
+
+def _outputs_identical(a: Path, b: Path) -> bool:
+    import numpy as np
+    fa = sorted(p.relative_to(a) for p in a.rglob("*.npy"))
+    fb = sorted(p.relative_to(b) for p in b.rglob("*.npy"))
+    if fa != fb or not fa:
+        return False
+    return all(np.array_equal(np.load(a / r), np.load(b / r)) for r in fa)
+
+
+def _single_family_args(base, fam, families):
+    """Project shared+dotted args onto ONE family's single run: its own
+    ``fam.key=`` overrides flatten to ``key=`` (what the multi run
+    applies for it), other families' dotted overrides drop — so the
+    sequential arm extracts exactly what the shared arm does."""
+    out = []
+    prefixes = {f"{g}." for g in families}
+    for a in base:
+        key = a.split("=", 1)[0]
+        head = key.split(".", 1)[0] + "."
+        if head == f"{fam}.":
+            out.append(a.split(".", 1)[1])
+        elif head not in prefixes:
+            out.append(a)
+    return out
+
+
+def run_families_ab(families, base, videos, workdir: Path,
+                    rounds: int) -> dict:
+    """Interleaved A/B: per round, time the N single-family runs back to
+    back (sequential baseline — N decode passes) THEN the one
+    shared-decode multi-family run, each into fresh output dirs so the
+    idempotent skip never hides work. Alternating within each round keeps
+    host thermal/cache drift from biasing either side; medians over
+    ``rounds`` are the published numbers, and the last round's outputs
+    are compared bit-for-bit (single vs shared must be identical)."""
+    import statistics
+    base = [a for a in base if not a.startswith("feature_type=")]
+    tmpdir = workdir / "tmp"
+    # untimed warmup per variant: weight load, page cache, jit compiles
+    for fam in families:
+        _timed_run([f"feature_type={fam}"]
+                   + _single_family_args(base, fam, families), videos[:1],
+                   workdir / f"warm_{fam}", tmpdir)
+    _timed_run([f"feature_type={','.join(families)}"] + base, videos[:1],
+               workdir / "warm_multi", tmpdir)
+    seq_s, shared_s = [], []
+    for r in range(rounds):
+        t_seq = 0.0
+        for fam in families:
+            t_seq += _timed_run(
+                [f"feature_type={fam}"]
+                + _single_family_args(base, fam, families), videos,
+                workdir / f"seq_r{r}_{fam}", tmpdir)
+        seq_s.append(round(t_seq, 2))
+        shared_s.append(round(_timed_run(
+            [f"feature_type={','.join(families)}"] + base, videos,
+            workdir / f"shared_r{r}", tmpdir), 2))
+    last = rounds - 1
+    seq_out = workdir / f"seq_r{last}_x"  # merge view: singles share the
+    seq_out.mkdir()                       # same family-namespaced layout
+    for fam in families:
+        for p in (workdir / f"seq_r{last}_{fam}").rglob("*.npy"):
+            rel = p.relative_to(workdir / f"seq_r{last}_{fam}")
+            (seq_out / rel).parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(p, seq_out / rel)
+    med_seq = statistics.median(seq_s)
+    med_shared = statistics.median(shared_s)
+    return {
+        "families": list(families),
+        "videos": len(videos),
+        "rounds": rounds,
+        "sequential_s": med_seq,
+        "shared_s": med_shared,
+        "sharing_ratio": round(med_seq / med_shared, 3),
+        "per_round": {"sequential_s": seq_s, "shared_s": shared_s},
+        "identical": _outputs_identical(seq_out,
+                                        workdir / f"shared_r{last}"),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--repeat", type=int, default=2,
                     help="copies of the sample video (distinct stems)")
     ap.add_argument("--video", default=str(SAMPLE),
                     help="source video to replicate")
+    ap.add_argument("--families", default=None, metavar="A,B[,C]",
+                    help="interleaved A/B: sequential single-family runs "
+                         "vs ONE shared-decode multi-family run "
+                         "(medians over --rounds; prints the sharing "
+                         "ratio and bit-identity verdict)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="A/B rounds for --families (medians)")
     # key=value / '::' tokens come back via parse_known_args, so --repeat
     # and --video are recognized wherever they appear on the command line
     opts, rest = ap.parse_known_args()
@@ -97,7 +207,11 @@ def main() -> None:
     bad = [a for a in rest if a != "::" and "=" not in a]
     if bad:
         raise SystemExit(f"unrecognized arguments: {bad} "
-                         "(expected key=value, '::', --repeat, --video)")
+                         "(expected key=value, '::', --repeat, --video, "
+                         "--families, --rounds)")
+    if opts.families and "::" in rest:
+        raise SystemExit("--families is its own A/B; '::' groups don't "
+                         "compose with it")
     if "::" in rest:
         # args before the first '::' are the baseline config; it runs AS the
         # first variant, and each '::'-separated group runs merged on top of
@@ -128,6 +242,15 @@ def main() -> None:
             dst = workdir / f"v_tp_{i:03d}.mp4"
             shutil.copy(src, dst)
             videos.append(str(dst))
+        if opts.families:
+            fams = [f.strip() for f in opts.families.split(",")
+                    if f.strip()]
+            if len(fams) < 2:
+                raise SystemExit("--families needs at least two "
+                                 "comma-separated family names")
+            print(json.dumps(run_families_ab(fams, configs[0], videos,
+                                             workdir, opts.rounds)))
+            return
         for i, cfg in enumerate(configs):
             print(json.dumps(run_config(cfg, videos, workdir, str(i))))
 

@@ -1,0 +1,285 @@
+"""Multi-family shared-decode extraction (parallel/fanout.py +
+extractors/multi.py + the CLI comma-list surface).
+
+Contracts pinned here:
+  - the FrameBus union decode pass delivers every subscriber a stream
+    bit-identical to its own private VideoSource (frames, timestamps,
+    indices, props) across resampled/native/total plans and rgb/bgr
+    channel orders;
+  - a multi-family CLI run produces BIT-IDENTICAL outputs to the
+    corresponding single-family runs (frame-wise + clip-stack + the
+    vggish audio family, video_workers 1 and 2), honoring per-family
+    dotted overrides;
+  - when every family's outputs already exist the video costs zero
+    decode (no SharedDecodeSession is even constructed) and the tally
+    counts per-family skips;
+  - one family's POISON failure journals/quarantines ONLY that family —
+    its siblings' outputs and journals stay clean.
+
+The wav rip is monkeypatched (no ffmpeg in CI): the synthesized sample
+has no audio track, and the deterministic per-stem tone makes the
+single-vs-multi vggish comparison meaningful while exercising the
+session's rip-once-share-many path.
+"""
+import json
+import shutil
+import threading
+import wave
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.parallel import fanout
+from video_features_tpu.parallel.fanout import FrameBus
+from video_features_tpu.utils.io import VideoSource
+
+#: frame-wise + clip-stack + audio, as the shared-decode design carves
+#: the world; keep overrides cheap — tier-1 runs on a 1-core CPU host
+FAMILY_OVERRIDES = {
+    "resnet": ["resnet.model_name=resnet18", "resnet.batch_size=8",
+               "resnet.extraction_total=6"],
+    "r21d": ["r21d.extraction_fps=1", "r21d.stack_size=10",
+             "r21d.step_size=10"],
+    "vggish": [],
+}
+
+
+def _fake_rip(video_path, tmp_path):
+    """Deterministic per-stem tone standing in for the ffmpeg wav rip:
+    same (video -> wav) function for single and multi runs, distinct
+    per video so a cross-video mixup in the shared session would show."""
+    stem = Path(video_path).stem
+    freq = 200.0 + zlib.crc32(stem.encode()) % 500
+    t = np.arange(int(16000 * 2.5)) / 16000.0
+    tone = (0.4 * np.sin(2 * np.pi * freq * t) * 32767).astype("<i2")
+    Path(tmp_path).mkdir(parents=True, exist_ok=True)
+    wav = Path(tmp_path) / f"{stem}.wav"
+    aac = Path(tmp_path) / f"{stem}.aac"  # the two-step rip's intermediate
+    with wave.open(str(wav), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(16000)
+        w.writeframes(tone.tobytes())
+    aac.write_bytes(b"")
+    return str(wav), str(aac)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _patched_wav_rip():
+    # module-scoped (plain monkeypatch is function-scoped): the
+    # module-scoped single_runs fixture below rips wavs too
+    mp = pytest.MonkeyPatch()
+    mp.setattr("video_features_tpu.extractors.vggish."
+               "extract_wav_from_mp4", _fake_rip)
+    yield
+    mp.undo()
+
+
+# ------------------------------------------------------------------ bus unit
+
+@pytest.mark.quick
+def test_bus_bit_identical_to_serial_sources(sample_video):
+    """Union decode == N private serial decodes, for resampled / native /
+    total plans, rgb / bgr delivery, with and without a transform."""
+    def tf(x):
+        return x[::4, ::4].astype(np.float32) / 255.0
+
+    specs = {
+        "a": dict(fps=3, transform=tf, channel_order="rgb"),
+        "b": dict(fps=1, transform=None, channel_order="bgr"),
+        "c": dict(total=7, transform=None, channel_order="rgb"),
+    }
+    bus = FrameBus(sample_video, list(specs), depth=8)
+    got, errs = {}, []
+
+    def consume(name, kw):
+        try:
+            sub = bus.subscribe(name, **kw)
+            got[name] = (list(sub.frames()), sub.fps, len(sub))
+        except BaseException as e:  # surfaced below, not swallowed
+            errs.append((name, e))
+
+    threads = [threading.Thread(target=consume, args=(n, kw))
+               for n, kw in specs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for name, kw in specs.items():
+        src = VideoSource(sample_video, **kw)
+        want = list(src.frames())
+        frames, fps, n = got[name]
+        assert (fps, n) == (src.fps, len(src)), name
+        assert len(frames) == len(want), name
+        for (xw, tw, iw), (xg, tg, ig) in zip(want, frames):
+            assert (tw, iw) == (tg, ig), name
+            np.testing.assert_array_equal(xw, xg, err_msg=name)
+        ms = bus.shared_ms(name)
+        assert ms is not None and ms > 0, (name, ms)
+
+
+def test_bus_probe_failure_poisons_every_family(tmp_path):
+    """A bus over an undecodable input fails each subscriber with the
+    worker-protocol-shaped error classify() maps to POISON."""
+    from video_features_tpu.utils import faults
+    bad = tmp_path / "not_a_video.mp4"
+    bad.write_bytes(b"junk")
+    bus = FrameBus(str(bad), ["a"], depth=4)
+    with pytest.raises(RuntimeError,
+                       match="shared decode probe failed") as ei:
+        bus.subscribe("a", fps=2)
+    assert faults.classify(ei.value) == faults.POISON
+    # duplicate/unexpected subscriptions decline -> private-source fallback
+    assert bus.subscribe("a") is None
+    assert bus.subscribe("b") is None
+
+
+# ------------------------------------------------------------- CLI E2E
+
+def _base_args(tmp_path, videos):
+    return ["device=cpu", "allow_random_weights=true",
+            "on_extraction=save_numpy", "retry_attempts=1",
+            f"tmp_path={tmp_path / 'tmp'}",
+            f"video_paths=[{','.join(videos)}]"]
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory, sample_video):
+    td = tmp_path_factory.mktemp("multi_corpus")
+    vids = []
+    for i in range(2):
+        dst = td / f"v_mf_{i}.mp4"
+        shutil.copy(sample_video, dst)
+        vids.append(str(dst))
+    return td, vids
+
+
+@pytest.fixture(scope="module")
+def single_runs(corpus, _patched_wav_rip):
+    """Reference single-family outputs, computed once for the module."""
+    from video_features_tpu.cli import main as cli_main
+    td, vids = corpus
+    out = td / "single"
+    for fam, over in FAMILY_OVERRIDES.items():
+        flat = [o.split(".", 1)[1] for o in over]  # strip the fam prefix
+        cli_main([f"feature_type={fam}", f"output_path={out}"]
+                 + flat + _base_args(td, vids))
+    return out
+
+
+@pytest.mark.parametrize("workers", [
+    pytest.param(1, marks=pytest.mark.quick),
+    # workers=2 (two concurrent shared-decode sessions) runs in the full
+    # CI tier; tier-1's 870s budget keeps the matrix at workers=1 (the
+    # per-video fan-out concurrency — 3 family threads — is exercised by
+    # every multi test regardless)
+    pytest.param(2, marks=pytest.mark.slow)])
+def test_multi_cli_bit_identical_to_singles(corpus, single_runs, tmp_path,
+                                            workers):
+    from video_features_tpu.cli import main as cli_main
+    td, vids = corpus
+    out = tmp_path / "multi"
+    families = ",".join(FAMILY_OVERRIDES)
+    overrides = [o for over in FAMILY_OVERRIDES.values() for o in over]
+    cli_main([f"feature_type={families}", f"output_path={out}",
+              f"video_workers={workers}", "telemetry=true"]
+             + overrides + _base_args(td, vids))
+
+    want = sorted(p.relative_to(single_runs)
+                  for p in single_runs.rglob("*.npy"))
+    got = sorted(p.relative_to(out) for p in out.rglob("*.npy"))
+    # resnet [feat, fps, timestamps] x2 videos + r21d x2 + vggish x2
+    assert want == got and len(want) == 10
+    for rel in want:
+        np.testing.assert_array_equal(
+            np.load(single_runs / rel), np.load(out / rel),
+            err_msg=f"{rel} differs between single-family and "
+                    f"shared-decode runs (workers={workers})")
+
+    # per-(video, family) spans carry the shared-decode attribution
+    spans = [json.loads(line)
+             for line in (out / "_telemetry.jsonl").open()]
+    by_fam = {}
+    for s in spans:
+        by_fam.setdefault(s["feature_type"], []).append(s)
+    assert sorted(by_fam) == sorted(FAMILY_OVERRIDES)
+    for fam in ("resnet", "r21d"):  # visual families shared the decode
+        assert all(s["status"] == "done" and s["decode_shared_ms"] > 0
+                   for s in by_fam[fam]), by_fam[fam]
+    assert all(s["decode_shared_ms"] is None for s in by_fam["vggish"])
+
+
+@pytest.mark.quick
+def test_multi_all_skipped_runs_zero_decode(corpus, single_runs,
+                                            monkeypatch, capsys):
+    """Second run over complete outputs: every family skips up front and
+    NO shared-decode session (hence no decoder, no wav rip) is built."""
+    from video_features_tpu.cli import main as cli_main
+    td, vids = corpus
+    families = ",".join(FAMILY_OVERRIDES)
+    overrides = [o for over in FAMILY_OVERRIDES.values() for o in over]
+    # the single-family reference outputs use the same namespacing the
+    # multi run expects, so pointing the multi run at them exercises the
+    # every-family-already-done path without re-extracting anything
+    argv = ([f"feature_type={families}", f"output_path={single_runs}"]
+            + overrides + _base_args(td, vids))
+    capsys.readouterr()
+
+    def _must_not_construct(*a, **kw):
+        raise AssertionError("all families already exist: the shared "
+                             "decode session must not be constructed")
+    monkeypatch.setattr(fanout, "SharedDecodeSession", _must_not_construct)
+    cli_main(argv)
+    outtxt = capsys.readouterr().out
+    assert f"{len(FAMILY_OVERRIDES) * len(vids)} already done" in outtxt
+    for fam in FAMILY_OVERRIDES:  # per-family skip tally in the summary
+        assert f"{fam}: 0 extracted, {len(vids)} already done" in outtxt
+
+
+@pytest.mark.quick
+def test_poison_family_is_isolated(corpus, tmp_path):
+    """An injected POISON failure in one family's transform journals and
+    fails ONLY that family; siblings' outputs + journals stay intact."""
+    from video_features_tpu.config import (load_multi_config,
+                                           sanity_check_multi)
+    from video_features_tpu.extractors.multi import MultiExtractor
+    from video_features_tpu.utils.faults import PoisonError
+
+    td, vids = corpus
+    out = tmp_path / "iso"
+    overrides = {
+        "feature_type": "resnet,r21d",
+        "device": "cpu", "allow_random_weights": True,
+        "on_extraction": "save_numpy", "retry_attempts": 1,
+        "output_path": str(out), "tmp_path": str(tmp_path / "t"),
+        "video_paths": vids[0],
+        "resnet": {"model_name": "resnet18", "batch_size": 8,
+                   "extraction_total": 6},
+        "r21d": {"extraction_fps": 1, "stack_size": 10, "step_size": 10},
+    }
+    per = load_multi_config(["resnet", "r21d"], overrides)
+    sanity_check_multi(per)
+    multi = MultiExtractor(per)
+
+    def poison_transform(frame):
+        raise PoisonError("injected: this family chokes on the input")
+    multi.extractors["r21d"].host_transform = poison_transform
+
+    failures = []
+    statuses = multi.run_video(vids[0], failures=failures)
+    assert statuses == {"resnet": "done", "r21d": "error"}
+    assert [f["family"] for f in failures] == ["r21d"]
+
+    stem = Path(vids[0]).stem
+    assert (out / "resnet" / "resnet18" / f"{stem}_resnet.npy").exists()
+    recs = [json.loads(line)
+            for line in open(multi.journals["r21d"].path)]
+    assert recs and recs[-1]["category"] == "POISON"
+    assert not Path(multi.journals["resnet"].path).exists()
+
+    # quarantine on the next run touches only the poisoned family
+    statuses2 = multi.run_video(vids[0])
+    assert statuses2 == {"resnet": "skipped", "r21d": "quarantined"}

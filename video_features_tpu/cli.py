@@ -53,12 +53,7 @@ def _enable_compilation_cache(args) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
-def main(argv: Optional[List[str]] = None) -> None:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    cli_args = parse_dotlist(argv)
-    if "feature_type" not in cli_args:
-        raise SystemExit("Usage: main.py feature_type=<family> [key=value ...]")
-    args = load_config(cli_args.feature_type, cli_args)
+def _maybe_init_distributed(args) -> None:
     if bool(args.get("distributed", False)):
         # multi-host pod slice: one process per host, launched by the TPU VM
         # runtime (GKE/gcloud); coordinator/process env comes from the
@@ -88,13 +83,51 @@ def main(argv: Optional[List[str]] = None) -> None:
         except RuntimeError as e:
             if "already" not in str(e).lower():
                 raise
-    sanity_check(args)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cli_args = parse_dotlist(argv)
+    if "feature_type" not in cli_args:
+        raise SystemExit("Usage: main.py feature_type=<family>[,<family>...]"
+                         " [key=value ...]")
+    from .registry import parse_feature_types
+    families = parse_feature_types(cli_args.feature_type)
+    multi_mode = len(families) > 1
+    if multi_mode:
+        # multi-family run: per-family configs (top-level keys shared,
+        # `family.key=` overrides private), ONE shared decode pass per
+        # video (extractors/multi.py + parallel/fanout.py)
+        from .config import load_multi_config, sanity_check_multi
+        per_family = load_multi_config(families, cli_args)
+        args = per_family[families[0]]
+        # the user-level output root, captured BEFORE sanity_check
+        # namespaces each family's own path under it: run-scoped
+        # artifacts (telemetry) live here, per-family sinks/journals in
+        # their subdirs
+        out_root = str(args.output_path)
+        _maybe_init_distributed(args)
+        sanity_check_multi(per_family)
+    else:
+        per_family = None
+        args = load_config(cli_args.feature_type, cli_args)
+        _maybe_init_distributed(args)
+        sanity_check(args)
+        out_root = str(args.output_path)
     _enable_compilation_cache(args)
-    verbose = args.get("on_extraction", "print") == "print"
+    verbose = (not multi_mode) and \
+        args.get("on_extraction", "print") == "print"
     if verbose:
         print(args.to_yaml())
 
-    extractor = get_extractor_cls(args.feature_type)(args)
+    if multi_mode:
+        from .extractors.multi import MultiExtractor
+        extractor = None
+        multi = MultiExtractor(per_family)
+    else:
+        multi = None
+        extractor = get_extractor_cls(args.feature_type)(args)
+    run_label = ",".join(families)
 
     video_paths = form_list_from_user_input(
         args.get("video_paths"), args.get("file_with_video_paths"),
@@ -135,6 +168,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         workers_arg = max(1, min(8, (_os.cpu_count() or 1) // 2))
     workers = int(workers_arg)
     tally = {"done": 0, "skipped": 0, "error": 0, "quarantined": 0}
+    # multi-family: the tally counts (video, family) units; this breaks
+    # them out per family for the end-of-run summary
+    fam_tally = {f: dict(tally) for f in families} if multi_mode else None
+    videos_run = [0]  # videos that entered run_one (vs dropped by SIGTERM)
     tally_lock = threading.Lock()
     t_run = time.perf_counter()
 
@@ -143,10 +180,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     # deadline watchdog, and — for file sinks — the persistent failure
     # journal that quarantines known-poison inputs across restarts. The
     # print sink has no resume contract, so it keeps no journal.
+    # (Multi-family runs carry one policy+journal PER FAMILY inside the
+    # MultiExtractor instead — a quarantine is a per-family verdict.)
     from .utils.faults import FailureJournal, RetryPolicy
-    policy = RetryPolicy.from_config(args)
-    journal = (FailureJournal(args.output_path)
-               if args.get("on_extraction", "print") != "print" else None)
+    policy = journal = None
+    if not multi_mode:
+        policy = RetryPolicy.from_config(args)
+        journal = (FailureJournal(args.output_path)
+                   if args.get("on_extraction", "print") != "print" else None)
     failures: List[dict] = []  # this run's terminal records (GIL-safe append)
 
     # Structured telemetry (telemetry=true): per-video span records in
@@ -166,16 +207,33 @@ def main(argv: Optional[List[str]] = None) -> None:
             host_id = f"p{jax.process_index()}-{host_id}"
         except Exception:
             pass
+        run_config = (_plain(args) if not multi_mode else
+                      {"feature_type": run_label,
+                       "families": {f: _plain(a)
+                                    for f, a in per_family.items()}})
         recorder = TelemetryRecorder(
-            args.output_path,
-            run_config=_plain(args),
-            feature_type=args.feature_type,
+            # multi: run-scoped artifacts live at the common output root
+            # (per-family sinks are namespaced beneath it); spans carry
+            # their own per-family feature_type
+            out_root,
+            run_config=run_config,
+            feature_type=run_label,
             interval_s=float(args.get("metrics_interval_s") or 30.0),
             host_id=host_id,
         ).start()
 
     def run_one(video_path: str) -> None:
         if stop.is_set():
+            return
+        with tally_lock:
+            videos_run[0] += 1
+        if multi is not None:
+            statuses = multi.run_video(video_path, recorder=recorder,
+                                       failures=failures)
+            with tally_lock:
+                for fam, status in statuses.items():
+                    tally[status] += 1
+                    fam_tally[fam][status] += 1
             return
         span_cm = (recorder.video_span(video_path)
                    if recorder is not None else NOOP_SPAN)
@@ -205,14 +263,25 @@ def main(argv: Optional[List[str]] = None) -> None:
                 # is unchanged. The reference's only cross-video parallelism
                 # was whole extra processes per GPU (reference README.md:
                 # 70-84).
-                from concurrent.futures import ThreadPoolExecutor
+                from concurrent.futures import (ThreadPoolExecutor,
+                                                as_completed)
                 with ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="vft-video") as pool:
+                    futures = [pool.submit(run_one, vp)
+                               for vp in video_paths]
                     try:
-                        done = pool.map(run_one, video_paths)
-                        for _ in tqdm(done, total=len(video_paths)):
-                            pass
-                    except KeyboardInterrupt:
+                        # completion order, not submission order: with
+                        # pool.map the bar (the operator's liveness read)
+                        # stalls on the slowest head-of-line video while
+                        # finished ones pile up uncounted behind it.
+                        # result() re-raises a worker's escaped exception,
+                        # as iterating pool.map's results did. SIGTERM
+                        # semantics are unchanged: queued videos still run
+                        # run_one, which drops them via the stop flag.
+                        for fut in tqdm(as_completed(futures),
+                                        total=len(futures)):
+                            fut.result()
+                    except BaseException:
                         # drop the not-yet-started videos; in-flight ones
                         # finish (their outputs stay valid thanks to atomic
                         # writes + resume-on-restart)
@@ -237,9 +306,15 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())
-    summary = (f"{n_run}/{len(video_paths)} videos in {elapsed:.1f}s: "
-               f"{tally['done']} extracted, {tally['skipped']} already done, "
-               f"{tally['error']} failed")
+    if multi_mode:
+        summary = (f"{videos_run[0]}/{len(video_paths)} videos x "
+                   f"{len(families)} families in {elapsed:.1f}s: "
+                   f"{tally['done']} extracted, {tally['skipped']} already "
+                   f"done, {tally['error']} failed")
+    else:
+        summary = (f"{n_run}/{len(video_paths)} videos in {elapsed:.1f}s: "
+                   f"{tally['done']} extracted, {tally['skipped']} already "
+                   f"done, {tally['error']} failed")
     if tally["quarantined"]:
         summary += f", {tally['quarantined']} quarantined"
     if failures:
@@ -251,17 +326,33 @@ def main(argv: Optional[List[str]] = None) -> None:
                                      for k, v in sorted(by_cat.items()))
                     + "]")
     if tally["done"]:
-        summary += f" ({tally['done'] / elapsed:.2f} videos/s)"
+        unit = "extractions/s" if multi_mode else "videos/s"
+        summary += f" ({tally['done'] / elapsed:.2f} {unit})"
     print(summary)
+    if multi_mode:
+        for fam in families:
+            ft = fam_tally[fam]
+            line = (f"  {fam}: {ft['done']} extracted, {ft['skipped']} "
+                    f"already done, {ft['error']} failed")
+            if ft["quarantined"]:
+                line += f", {ft['quarantined']} quarantined"
+            print(line)
+    if failures and multi_mode:
+        for fam in sorted({rec.get("family") for rec in failures
+                           if rec.get("family")}):
+            j = multi.journals.get(fam)
+            if j is not None:
+                print(f"failure journal ({fam}): {j.path} "
+                      "(retry_failed=true re-runs quarantined videos)")
     if failures and journal is not None:
         print(f"failure journal: {journal.path} (retry_failed=true re-runs "
               "quarantined videos)")
     if recorder is not None:
         print(f"telemetry: {recorder.manifest_path} + {recorder.spans_path} "
               f"(render with scripts/telemetry_report.py "
-              f"{args.output_path})")
+              f"{out_root})")
     if profiler.enabled:
-        print(profiler.summary(f"profile: {args.feature_type} x "
+        print(profiler.summary(f"profile: {run_label} x "
                                f"{len(video_paths)} videos"))
     if stop.is_set():
         raise SystemExit(143)  # conventional SIGTERM exit; resume = re-run

@@ -140,6 +140,68 @@ def load_config(feature_type: str,
     return cfg
 
 
+def load_multi_config(families: Sequence[str],
+                      overrides: Optional[Union[Config, Dict[str, Any]]] = None,
+                      ) -> "Dict[str, Config]":
+    """Per-family configs for a multi-family run (ordered like ``families``).
+
+    Override routing: top-level CLI keys are SHARED (merged into every
+    family's YAML defaults); a key nested under a requested family name is
+    that family's private override and wins over the shared layer —
+    ``feature_type=resnet,clip extraction_fps=1 clip.extraction_fps=2``
+    runs resnet at 1 fps and clip at 2. A nested override for a known
+    family that is NOT requested is almost certainly a typo'd run and
+    fails loudly instead of silently extracting nothing for it.
+    """
+    from .registry import _DISPATCH
+    families = list(families)
+    overrides = Config(dict(overrides or {}))
+    shared = {k: v for k, v in overrides.items()
+              if k != "feature_type" and k not in families}
+    for k in list(shared):
+        if k in _DISPATCH and isinstance(shared[k], dict):
+            raise ValueError(
+                f"per-family override block {k}.* given, but {k!r} is not "
+                f"in feature_type={','.join(families)} — add it to the "
+                "list or drop the override")
+    per: Dict[str, Config] = {}
+    for f in families:
+        fam_over = overrides.get(f)
+        merged = Config(dict(shared))
+        if isinstance(fam_over, dict):
+            merged = merge(merged, Config(dict(fam_over)))
+        cfg = load_config(f, merged)
+        cfg.feature_type = f
+        per[f] = cfg
+    return per
+
+
+def sanity_check_multi(per_family: "Dict[str, Config]") -> None:
+    """Multi-family constraints, then the normal per-family sanity_check
+    (which namespaces each family's output/tmp paths under its own
+    ``feature_type[/model_name]`` subdir — so sinks and journals never
+    collide across families)."""
+    for f, args in per_family.items():
+        if args.get("on_extraction", "print") == "print":
+            raise ValueError(
+                "multi-family extraction needs a file sink "
+                "(on_extraction=save_numpy or save_pickle): N families' "
+                "print dumps would interleave, and the per-family skip/"
+                "journal contracts need per-family output dirs")
+        if args.get("show_pred"):
+            raise ValueError(
+                "show_pred=true is unsupported in multi-family runs "
+                "(per-batch prediction printing would interleave across "
+                "families)")
+        if (args.get("fps_mode", "select") or "select") == "reencode":
+            raise ValueError(
+                "fps_mode=reencode is unsupported in multi-family runs: "
+                "each family's reencode provenance is its own lossy "
+                "temp-file decode, which cannot share one pass — run "
+                "golden-parity extractions one family at a time")
+        sanity_check(args)
+
+
 def resolve_device(device: Optional[str]) -> str:
     """Map a user device string to 'tpu' or 'cpu'.
 

@@ -68,8 +68,30 @@ class BaseExtractor:
         active on this thread, its ``decode_override`` (the degradation
         ladder's demoted mode for a retry) replaces ``video_decode``, and
         the constructed source is registered so the per-video deadline
-        watchdog can kill its in-flight decode."""
+        watchdog can kill its in-flight decode.
+
+        Shared-decode hook (parallel/fanout.py): inside a multi-family
+        run a SharedDecodeSession is installed on this thread; the first
+        attempt subscribes to the video's single shared decode pass and
+        gets a SharedFrameSource with the same observable surface. A
+        declined subscription (retry attempt, unsupported knob) falls
+        through to a private source below — isolation over sharing."""
+        from ..parallel import fanout
         from ..utils import faults
+        session = fanout.current_session()
+        if session is not None:
+            sub = session.subscribe(self.feature_type, **kwargs)
+            if sub is not None:
+                # the bus registered it with the fault context already
+                # (before its arrival barrier, so the watchdog can cancel
+                # a family stuck waiting for its siblings)
+                from .. import telemetry
+                if telemetry.current_span() is not None:
+                    telemetry.annotate(video_fps=sub.fps,
+                                       video_frames=len(sub))
+                    telemetry.event("source", mode="shared",
+                                    cls=type(sub).__name__)
+                return sub
         from ..utils.io import (ParallelVideoSource, ProcessVideoSource,
                                 VideoSource)
         ctx = faults.current_context()

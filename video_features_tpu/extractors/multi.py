@@ -1,0 +1,144 @@
+"""Multi-family driver: compose per-family extractors over ONE decode.
+
+``feature_type=resnet,clip,s3d`` runs every requested family per video
+with a single shared decode pass (parallel/fanout.py) instead of N
+invocations each paying the full cv2 decode cost — the last
+order-of-magnitude-class end-to-end win on decode-bound hosts
+(docs/performance.md "Decode once, extract many").
+
+Composition, not reimplementation: each family keeps its OWN extractor
+instance, config (per-family dotted overrides like
+``clip.extraction_fps=2``), output directory + idempotent skip, retry
+policy, failure journal, and telemetry span — the MultiExtractor only
+coordinates. Per video:
+
+  1. **Skip sweep** — families whose outputs already exist are tallied
+     ``skipped`` up front; when EVERY family skips, no decoder (or wav
+     rip) is even constructed.
+  2. **Shared session** — remaining visual families subscribe to one
+     :class:`~..parallel.fanout.FrameBus` (union frame plan, per-family
+     bounded queues); audio families share one wav rip.
+  3. **Per-family threads** — each family runs its existing
+     ``safe_extract`` lifecycle (retries, quarantine, journal, span) on
+     its own thread, so all families' transforms and device programs are
+     in flight together and one family's POISON failure or quarantine
+     cannot touch its siblings' outputs (tests/test_multi_family.py pins
+     both the bit-identity and the isolation).
+
+Retry attempts after a mid-stream failure cannot rejoin the one-shot
+shared pass; they fall back to a private ``VideoSource`` (correctness
+over sharing for the rare retry). The decode degradation ladder is
+likewise a private-source concern, so ``safe_extract`` runs with
+``decode_mode=None`` here.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..config import Config
+from ..parallel import fanout
+from ..registry import AUDIO_FAMILIES, get_extractor_cls
+from ..utils import sinks
+from ..utils.faults import FailureJournal, RetryPolicy
+
+
+class MultiExtractor:
+    """Drives N per-family extractors through shared-decode sessions."""
+
+    def __init__(self, per_family_args: Dict[str, Config]) -> None:
+        self.families: List[str] = list(per_family_args)
+        self.args = dict(per_family_args)
+        self.extractors = {f: get_extractor_cls(f)(a)
+                           for f, a in per_family_args.items()}
+        self.policies = {f: RetryPolicy.from_config(a)
+                         for f, a in per_family_args.items()}
+        # per-family journal in the family's own (namespaced) output dir:
+        # quarantine verdicts must not leak across families
+        self.journals = {
+            f: (FailureJournal(a.output_path)
+                if a.get("on_extraction", "print") != "print" else None)
+            for f, a in per_family_args.items()}
+        first = next(iter(per_family_args.values()))
+        raw_depth = first.get("fanout_depth")
+        self.fanout_depth = (fanout.DEFAULT_DEPTH if raw_depth is None
+                             else int(raw_depth))
+        if self.fanout_depth < 2:
+            raise ValueError(
+                f"fanout_depth={self.fanout_depth}: need >= 2")
+        self.keep_tmp = any(bool(a.get("keep_tmp_files", False))
+                            for a in per_family_args.values())
+
+    # ------------------------------------------------------------------
+    def run_video(self, video_path: str, recorder=None,
+                  failures: Optional[list] = None) -> Dict[str, str]:
+        """One video through every family; returns {family: status} with
+        the same status vocabulary as ``safe_extract``."""
+        from ..telemetry import NOOP_SPAN
+
+        statuses: Dict[str, str] = {}
+        pending: List[str] = []
+        for f in self.families:
+            ext = self.extractors[f]
+            if sinks.is_already_exist(ext.on_extraction, ext.output_path,
+                                      video_path, ext.output_feat_keys):
+                # up-front per-family skip: when every family lands here
+                # the video costs ZERO decode (no bus, no wav rip)
+                statuses[f] = "skipped"
+                if recorder is not None:
+                    with recorder.video_span(video_path,
+                                             feature_type=f) as span:
+                        span.annotate(status="skipped")
+            else:
+                pending.append(f)
+        if not pending:
+            return statuses
+
+        visual = [f for f in pending if f not in AUDIO_FAMILIES]
+        session = fanout.SharedDecodeSession(video_path, visual,
+                                             depth=self.fanout_depth)
+
+        def family_job(f: str) -> None:
+            ext = self.extractors[f]
+            span_cm = (recorder.video_span(video_path, feature_type=f)
+                       if recorder is not None else NOOP_SPAN)
+            try:
+                with fanout.use_session(session):
+                    with span_cm as span:
+                        status = sinks.safe_extract(
+                            ext._extract, video_path,
+                            policy=self.policies[f],
+                            journal=self.journals.get(f),
+                            decode_mode=None,
+                            on_terminal_failure=(
+                                None if failures is None else
+                                lambda rec: failures.append(
+                                    {**rec, "family": f})))
+                        span.annotate(status=status)
+                        ms = session.shared_ms(f)
+                        if ms is not None:
+                            span.annotate(decode_shared_ms=ms)
+                statuses[f] = status
+            except BaseException:
+                # safe_extract re-raises only KeyboardInterrupt/SystemExit
+                # -class exits; on a thread those kill just this family
+                statuses.setdefault(f, "error")
+                raise
+            finally:
+                # barrier release for families that never subscribed
+                # (skipped on re-check, quarantined, failed pre-decode)
+                session.family_done(f)
+
+        threads = [threading.Thread(target=family_job, args=(f,),
+                                    name=f"vft-family-{f}", daemon=True)
+                   for f in pending]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            session.cleanup(keep_tmp=self.keep_tmp)
+        for f in pending:  # a thread that died abnormally left no status
+            statuses.setdefault(f, "error")
+        return statuses

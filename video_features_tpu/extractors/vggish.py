@@ -91,9 +91,19 @@ class ExtractVGGish(BaseExtractor):
         ext = Path(video_path).suffix
         wav_path, aac_path = None, None
         if ext == ".mp4":
-            wav_path, aac_path = extract_wav_from_mp4(video_path,
-                                                      self.tmp_path)
-            audio_path = wav_path
+            from ..parallel import fanout
+            session = fanout.current_session()
+            if session is not None:
+                # multi-family run: ONE wav rip per video shared by every
+                # audio family; the session owns the temp files' cleanup
+                # (after all audio consumers finish), so wav_path stays
+                # None and the removal below is skipped
+                audio_path = session.shared_wav(video_path, self.tmp_path,
+                                                extract_wav_from_mp4)
+            else:
+                wav_path, aac_path = extract_wav_from_mp4(video_path,
+                                                          self.tmp_path)
+                audio_path = wav_path
         elif ext == ".wav":
             audio_path = video_path
         else:

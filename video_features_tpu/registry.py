@@ -6,7 +6,7 @@ actually requested.
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Type
+from typing import TYPE_CHECKING, List, Type
 
 
 _DISPATCH = {
@@ -19,6 +19,31 @@ _DISPATCH = {
     "raft": ("raft", "ExtractRAFT"),
     "pwc": ("pwc", "ExtractPWC"),
 }
+
+#: families that consume the AUDIO track: in a multi-family run they
+#: share one wav rip per video instead of subscribing to the FrameBus
+AUDIO_FAMILIES = frozenset({"vggish"})
+
+
+def parse_feature_types(feature_type: str) -> List[str]:
+    """``'resnet,clip,s3d'`` -> ``['resnet', 'clip', 's3d']``.
+
+    A single name passes through as a one-element list; every name must
+    be registered and unique (duplicate families would race on the same
+    output files)."""
+    fams = [f.strip() for f in str(feature_type).split(",") if f.strip()]
+    if not fams:
+        raise NotImplementedError(f"Unknown feature_type: {feature_type!r}")
+    seen = set()
+    for f in fams:
+        if f not in _DISPATCH:
+            raise NotImplementedError(f"Unknown feature_type: {f!r}")
+        if f in seen:
+            raise ValueError(
+                f"feature_type={feature_type!r}: family {f!r} is listed "
+                "twice (its outputs would race on the same files)")
+        seen.add(f)
+    return fams
 
 
 def get_extractor_cls(feature_type: str) -> Type:

@@ -164,9 +164,12 @@ class TelemetryRecorder:
                   f"{type(e).__name__}: {e}")
 
     # -- spans --------------------------------------------------------------
-    def video_span(self, video: str) -> VideoSpan:
+    def video_span(self, video: str,
+                   feature_type: Optional[str] = None) -> VideoSpan:
+        # multi-family runs share one recorder but stamp each span with
+        # its own family, so per-(video, family) records stay queryable
         return VideoSpan(video, recorder=self,
-                         feature_type=self.feature_type,
+                         feature_type=feature_type or self.feature_type,
                          host_id=self.host_id)
 
     def emit_span(self, record: dict) -> None:

@@ -42,8 +42,8 @@ STATUSES = ("done", "skipped", "error", "quarantined")
 SPAN_FIELDS = (
     "schema", "video", "status", "feature_type", "host", "host_id", "pid",
     "start_time", "wall_s", "attempts", "category", "error",
-    "decode_mode", "ladder_steps", "stages", "video_fps", "video_frames",
-    "events",
+    "decode_mode", "decode_shared_ms", "ladder_steps", "stages",
+    "video_fps", "video_frames", "events",
 )
 
 _tls = threading.local()
@@ -159,6 +159,10 @@ class VideoSpan:
             "category": attrs.get("category"),
             "error": None if err is None else str(err)[:1000],
             "decode_mode": attrs.get("decode_mode"),
+            # multi-family shared-decode attribution: ms of the video's
+            # single decode pass that had run when this family's stream
+            # completed (parallel/fanout.py); null for private decodes
+            "decode_shared_ms": _maybe_float(attrs.get("decode_shared_ms")),
             "ladder_steps": ladder,
             "stages": stages,
             "video_fps": _maybe_float(attrs.get("video_fps")),

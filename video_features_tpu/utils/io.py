@@ -125,6 +125,40 @@ def fps_filter_map(num_frames: int, src_fps: float, dst_fps: float) -> np.ndarra
     return mapping
 
 
+def plan_frame_selection(src_fps: float, src_num_frames: int,
+                         fps: Optional[float] = None,
+                         total: Optional[int] = None,
+                         total_cap: Optional[int] = None,
+                         ) -> Tuple[float, Optional[np.ndarray], int]:
+    """Resolve one consumer's ``fps``/``total`` request against a source
+    stream: ``(out_fps, index_map_or_None, num_frames)``.
+
+    This is the frame-selection walk every decoded-stream consumer agrees
+    on — :class:`VideoSource` applies it serially, and the multi-family
+    shared-decode bus (parallel/fanout.py) computes each subscriber's plan
+    with the SAME function so the union decode pass is provably
+    bit-identical to N independent serial passes. ``index_map=None``
+    means native delivery (every source frame, out index == src index);
+    ``total_cap`` reproduces the reencode+total stop-early contract
+    (reference utils/io.py:117-119) for VideoSource's temp-file path.
+    Callers must resolve a lying ``src_num_frames <= 0`` (see
+    :func:`count_frames_by_decode`) before requesting a resampling plan.
+    """
+    if total is not None:
+        # reference utils/io.py:83-89: derive the fps that yields ~total
+        fps = total * src_fps / max(src_num_frames, 1)
+    if fps is not None:
+        index_map = fps_filter_map(src_num_frames, src_fps, float(fps))
+        if total is not None:
+            index_map = index_map[:total]
+        return float(fps), index_map, len(index_map)
+    num_frames = src_num_frames
+    if total_cap is not None:
+        num_frames = min(num_frames, total_cap) if num_frames > 0 \
+            else total_cap
+    return float(src_fps), None, num_frames
+
+
 def reencode_video_with_diff_fps(video_path: Union[str, Path],
                                  tmp_path: Union[str, Path],
                                  extraction_fps: float,
@@ -330,25 +364,9 @@ class VideoSource:
             self.src_num_frames = count_frames_by_decode(self.path)
             if self.src_num_frames == 0:
                 raise ValueError(f"No decodable frames in {self.path}")
-        if total is not None:
-            # reference utils/io.py:83-89: derive the fps that yields ~total
-            fps = total * self.src_fps / max(self.src_num_frames, 1)
-        if fps is not None:
-            self.fps = float(fps)
-            self.index_map: Optional[np.ndarray] = fps_filter_map(
-                self.src_num_frames, self.src_fps, self.fps)
-            if total is not None:
-                self.index_map = self.index_map[:total]
-            self.num_frames = len(self.index_map)
-        else:
-            self.fps = self.src_fps
-            self.index_map = None
-            self.num_frames = self.src_num_frames
-            if self._total_cap is not None:
-                # reencode+total: the reference stops at len(self)==total
-                # or stream end, whichever first (utils/io.py:117-119)
-                self.num_frames = min(self.num_frames, self._total_cap) \
-                    if self.num_frames > 0 else self._total_cap
+        self.fps, self.index_map, self.num_frames = plan_frame_selection(
+            self.src_fps, self.src_num_frames, fps=fps, total=total,
+            total_cap=self._total_cap)
 
     def __len__(self):
         return self.num_frames

@@ -20,9 +20,12 @@ each carries ``metadata={op_name=... source_file=...}`` pointing at the
 Python that emitted it.
 
 Caveat (tunneled dev chips): events here are DEVICE timeline spans, so they
-are trustworthy even where wall-clock microbenchmarks are not; but nested
-spans (e.g. a while loop and the fusions inside it) each carry their full
-duration, so the table over-counts hierarchies — read it top-down.
+are trustworthy even where wall-clock microbenchmarks are not. By default,
+nested spans (e.g. a while loop and the fusions inside it) each carry their
+full duration, so the table over-counts hierarchies — read it top-down, or
+pass ``--self-time`` to subtract every span's nested children before
+ranking (each op then carries only its exclusive time, and the totals sum
+to real device time instead of over-counting).
 """
 import argparse
 import collections
@@ -55,18 +58,55 @@ def load_trace(trace_dir: str) -> dict:
         return json.load(f)
 
 
-def device_op_table(trace: dict):
-    """[(name, total_us)] for complete events on device-side process rows."""
+def _self_durations(events):
+    """``(name, dur_minus_nested_children)`` per event: a per-(pid, tid)
+    stack walk over start-sorted complete events, subtracting each span's
+    DIRECT children from it (grandchildren subtract from their own parent),
+    so totals sum to real device time instead of over-counting nests."""
+    out = []
+    tracks = collections.defaultdict(list)
+    for e in events:
+        tracks[(e.get("pid"), e.get("tid"))].append(e)
+    for track in tracks.values():
+        # ties: the longer span is the parent and must be pushed first
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # [end_ts, child_dur_sum, name, dur]
+        for e in track:
+            while stack and stack[-1][0] <= e["ts"]:
+                end, child, name, dur = stack.pop()
+                out.append((name, max(dur - child, 0)))
+            if stack:
+                stack[-1][1] += e["dur"]
+            stack.append([e["ts"] + e["dur"], 0, e["name"], e["dur"]])
+        while stack:
+            end, child, name, dur = stack.pop()
+            out.append((name, max(dur - child, 0)))
+    return out
+
+
+def device_op_table(trace: dict, self_time: bool = False):
+    """[(name, total_us)] for complete events on device-side process rows.
+
+    ``self_time=True`` ranks by exclusive duration (nested children
+    subtracted) instead of inclusive — the fix for the hierarchy
+    over-count this module's docstring warns about."""
     events = trace.get("traceEvents", [])
     proc_names = {e["pid"]: e.get("args", {}).get("name", "")
                   for e in events
                   if e.get("ph") == "M" and e.get("name") == "process_name"}
-    per_op = collections.Counter()
+    device_events = []
     for e in events:
         if e.get("ph") == "X" and "dur" in e:
             pname = proc_names.get(e.get("pid"), "")
             if "TPU" in pname or "GPU" in pname:
-                per_op[e["name"]] += e["dur"]
+                device_events.append(e)
+    per_op = collections.Counter()
+    if self_time:
+        for name, dur in _self_durations(device_events):
+            per_op[name] += dur
+    else:
+        for e in device_events:
+            per_op[e["name"]] += e["dur"]
     return per_op.most_common()
 
 
@@ -78,11 +118,16 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=1,
                     help="timed steps in the capture: durations are "
                          "divided by this")
+    ap.add_argument("--self-time", action="store_true",
+                    help="rank by exclusive time (nested children "
+                         "subtracted) — totals then sum to real device "
+                         "time instead of over-counting hierarchies")
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
 
-    table = device_op_table(load_trace(args.trace_dir))
+    table = device_op_table(load_trace(args.trace_dir),
+                            self_time=args.self_time)
     if not table:
         raise SystemExit("no device-side complete events found (CPU-only "
                          "trace? the device timeline needs a TPU/GPU run)")
@@ -91,8 +136,10 @@ def main() -> None:
     for name, us in table[:args.top]:
         print(f"{us / args.iters / 1e3:10.2f}  {us / total * 100:5.1f}%  "
               f"{name[:100]}")
-    print(f"\ntotal device time: {total / args.iters / 1e3:.1f} ms/iter "
-          f"(nested spans over-count; read top-down)")
+    kind = ("self time (exclusive, nests subtracted)" if args.self_time
+            else "inclusive time (nested spans over-count; read top-down, "
+                 "or use --self-time)")
+    print(f"\ntotal device {kind}: {total / args.iters / 1e3:.1f} ms/iter")
     sys.exit(0)
 
 

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""Render a ``_trace.json`` host-pipeline timeline without Perfetto.
+
+Companion to the ``trace=true`` CLI knob (telemetry/trace.py): point it
+at the run's output directory (or the ``_trace.json`` itself) and get
+
+  - **per-thread utilization** — how busy each lane (bus decoder, family
+    threads, prefetchers, video workers) actually was over the run;
+  - **top stalls** — the longest backpressure waits
+    (``fanout.put_blocked`` / ``fanout.get_starved`` /
+    ``fanout.subscribe_wait`` / ``prefetch.put_blocked`` /
+    ``retry_backoff``), each naming its family/video;
+  - **per-video critical path** — decode vs transform vs device vs write
+    time inside each ``video_attempt`` window, with a *-bound verdict
+    per video and for the whole run. This is the arithmetic behind
+    docs/observability.md's diagnosis of the PR 3 "decode 2x, E2E ~1x"
+    result.
+
+Usage:
+    python main.py feature_type=a,b,c ... trace=true
+    python scripts/trace_report.py {output_path} [--top 10]
+    python scripts/trace_report.py {output_path} \
+        --merge /tmp/jaxtrace [--out combined.json]
+
+``--merge`` splices the host timeline with a ``jax.profiler`` device
+capture (``profile_trace_dir=``, the same trace-event format) into ONE
+file Perfetto loads — host lanes and device op lanes side by side. Both
+timelines are shifted to start at 0; absolute clock alignment between
+the two captures is NOT attempted (start your capture with the run and
+read the overlap structurally, not by microsecond).
+
+Bucket heuristic for the verdict: ``forward`` spans are device time
+(under async dispatch: device *stall* time), ``write`` spans are sink
+IO, and ``decode`` spans split by thread — on the shared-decode bus
+thread (``vft-fanout-decode``) they are pure cv2 decode, on family/
+prefetch/worker threads they are host transform work (in single-family
+runs, decode+transform conflated — the serial path times them as one
+stage).
+
+A file torn by an abrupt exit fails with a clear message: the recorder
+finalizes via temp+``os.replace``, so a half-written ``_trace.json``
+means the run died before ``TraceRecorder.close()`` ran.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from video_features_tpu.telemetry.trace import (  # noqa: E402
+    STALL_SPAN_NAMES, TRACE_FILENAME)
+
+#: decode-lane thread-name prefix (parallel/fanout.py names its union
+#: decoder thread this); used to split "decode" into decode vs transform
+DECODE_THREAD_NAME = "vft-fanout-decode"
+
+#: stage-name -> report bucket (thread-dependent for "decode", see below)
+BUCKETS = ("decode", "transform", "device", "write", "stall")
+
+#: umbrella spans bracket a whole job INCLUDING its idle waits — they
+#: cut windows (critical path) but must not count as busy time
+UMBRELLA_SPAN_NAMES = ("family", "video_attempt", "fanout.decode_pass")
+
+
+def load_host_trace(path: str) -> Tuple[dict, str]:
+    """Load ``_trace.json`` (or find it under an output dir), failing
+    with an actionable message — never a JSON traceback — on a missing,
+    truncated or non-trace file."""
+    if os.path.isdir(path):
+        path = os.path.join(path, TRACE_FILENAME)
+    if not os.path.exists(path):
+        raise SystemExit(f"no {TRACE_FILENAME} at {path} — was the run "
+                         "launched with trace=true?")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise SystemExit(
+            f"{path} is not a complete JSON trace ({e}). The recorder "
+            "writes it atomically at close, so a torn file means the run "
+            "died before TraceRecorder.close() (SIGKILL/OOM?) or the file "
+            "was truncated afterwards — re-run with trace=true.") from None
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise SystemExit(f"{path} parsed as JSON but has no 'traceEvents' "
+                         "array — not a Chrome trace-event file")
+    return doc, path
+
+
+def thread_names(events: List[dict]) -> Dict[int, str]:
+    return {e.get("tid"): e.get("args", {}).get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+
+
+def complete_events(events: List[dict]) -> List[dict]:
+    return [e for e in events
+            if e.get("ph") == "X" and isinstance(e.get("dur"), (int, float))]
+
+
+def _union_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals — nested spans
+    (a stage inside an attempt) must not double-count busy time."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total, cur_s, cur_e = 0.0, intervals[0][0], intervals[0][1]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def utilization_table(xs: List[dict], names: Dict[int, str]) -> List[str]:
+    if not xs:
+        return ["(no complete events)"]
+    t0 = min(e["ts"] for e in xs)
+    t1 = max(e["ts"] + e["dur"] for e in xs)
+    wall = max(t1 - t0, 1e-9)
+    by_tid: Dict[int, List[Tuple[float, float]]] = {}
+    for e in xs:
+        if e["name"] in UMBRELLA_SPAN_NAMES \
+                or e["name"] in STALL_SPAN_NAMES:
+            continue  # waits are not work
+        by_tid.setdefault(e["tid"], []).append(
+            (e["ts"], e["ts"] + e["dur"]))
+    if not by_tid:
+        return ["(only umbrella/stall spans present)"]
+    lines = [f"timeline wall: {wall / 1e3:.1f} ms across "
+             f"{len(by_tid)} threads",
+             f"{'busy ms':>10}  {'util':>6}  thread"]
+    rows = []
+    for tid, iv in by_tid.items():
+        busy = _union_us(iv)
+        rows.append((busy, names.get(tid) or f"tid {tid}"))
+    for busy, name in sorted(rows, reverse=True):
+        lines.append(f"{busy / 1e3:10.1f}  {busy / wall * 100:5.1f}%  "
+                     f"{name}")
+    return lines
+
+
+def top_stalls(xs: List[dict], top: int) -> List[str]:
+    stalls = [e for e in xs if e["name"] in STALL_SPAN_NAMES]
+    if not stalls:
+        return ["(no stalls past the 1 ms trace threshold — the pipeline "
+                "never waited on itself)"]
+    total_by_name: Dict[str, float] = {}
+    for e in stalls:
+        total_by_name[e["name"]] = total_by_name.get(e["name"], 0) + e["dur"]
+    lines = ["totals: " + ", ".join(
+        f"{n} {v / 1e3:.1f} ms" for n, v in
+        sorted(total_by_name.items(), key=lambda kv: -kv[1]))]
+    lines.append(f"{'ms':>9}  stall")
+    for e in sorted(stalls, key=lambda e: -e["dur"])[:top]:
+        args = e.get("args", {})
+        tag = args.get("family") or os.path.basename(
+            str(args.get("video", "")))
+        lines.append(f"{e['dur'] / 1e3:9.1f}  {e['name']}"
+                     + (f" [{tag}]" if tag else ""))
+    return lines
+
+
+def _overlap(e: dict, w0: float, w1: float) -> float:
+    return max(0.0, min(e["ts"] + e["dur"], w1) - max(e["ts"], w0))
+
+
+def bucket_of(e: dict, names: Dict[int, str],
+              has_bus: bool) -> Optional[str]:
+    n = e["name"]
+    if n == "forward":
+        return "device"
+    if n == "write":
+        return "write"
+    if n in STALL_SPAN_NAMES:
+        return "stall"
+    if n == "decode":
+        if not has_bus:
+            return "decode"  # serial path: decode+transform as one stage
+        tname = names.get(e["tid"], "")
+        return "decode" if tname.startswith(DECODE_THREAD_NAME) \
+            else "transform"
+    return None
+
+
+def critical_path(xs: List[dict], names: Dict[int, str],
+                  ) -> Tuple[List[str], Dict[str, float]]:
+    """Per-video decode/transform/device/write split inside each video's
+    ``video_attempt`` windows, plus run-wide bucket totals."""
+    attempts = [e for e in xs if e["name"] == "video_attempt"]
+    has_bus = any(str(n).startswith(DECODE_THREAD_NAME)
+                  for n in names.values())
+    totals = {b: 0.0 for b in BUCKETS}
+    if not attempts:
+        return (["(no video_attempt spans — nothing ran, or the trace "
+                 "predates this instrumentation)"], totals)
+    windows: Dict[str, List[Tuple[float, float]]] = {}
+    for e in attempts:
+        video = str(e.get("args", {}).get("video", "?"))
+        windows.setdefault(video, []).append((e["ts"], e["ts"] + e["dur"]))
+    lines = [f"{'video':<40} {'wall ms':>9}  "
+             + "  ".join(f"{b[:9]:>9}" for b in BUCKETS) + "  verdict"]
+    stage_events = [e for e in xs if bucket_of(e, names, has_bus)]
+    for video, ws in sorted(windows.items()):
+        per = {b: 0.0 for b in BUCKETS}
+        for e in stage_events:
+            b = bucket_of(e, names, has_bus)
+            ov = sum(_overlap(e, w0, w1) for w0, w1 in ws)
+            if ov > 0:
+                per[b] += ov
+        for b in BUCKETS:
+            totals[b] += per[b]
+        wall = sum(w1 - w0 for w0, w1 in ws)
+        verdict = max(per, key=per.get) if any(per.values()) else "?"
+        lines.append(
+            f"{os.path.basename(video)[:40]:<40} {wall / 1e3:9.1f}  "
+            + "  ".join(f"{per[b] / 1e3:9.1f}" for b in BUCKETS)
+            + f"  {verdict}-bound")
+    return lines, totals
+
+
+def merge_traces(host: dict, device: dict) -> dict:
+    """One Perfetto-loadable file: device trace as-is (rebased to t=0),
+    host lanes rebased to t=0 under a remapped pid. No cross-clock
+    alignment — see the module docstring."""
+    dev_events = [e for e in device.get("traceEvents", [])
+                  if isinstance(e, dict)]
+    host_events = [dict(e) for e in host.get("traceEvents", [])
+                   if isinstance(e, dict)]
+
+    def rebase(events: List[dict]) -> None:
+        stamped = [e["ts"] for e in events
+                   if isinstance(e.get("ts"), (int, float))]
+        if not stamped:
+            return
+        t0 = min(stamped)
+        for e in events:
+            if isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] - t0
+
+    dev_events = [dict(e) for e in dev_events]
+    rebase(dev_events)
+    rebase(host_events)
+    dev_pids = [e.get("pid") for e in dev_events
+                if isinstance(e.get("pid"), int)]
+    host_pid = (max(dev_pids) if dev_pids else 0) + 100000
+    for e in host_events:
+        e["pid"] = host_pid
+    return {"traceEvents": dev_events + host_events,
+            "displayTimeUnit": "ms",
+            "otherData": {"merged": "vft host trace + jax.profiler device "
+                                    "trace, both rebased to t=0"}}
+
+
+def _load_device_trace(trace_dir: str) -> dict:
+    # reuse the capture-discovery logic profile_trace.py already has
+    # (newest run dir, one host, .gz handling)
+    import profile_trace
+    return profile_trace.load_trace(trace_dir)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="host-pipeline timeline report for a trace=true run")
+    ap.add_argument("path", help="run output dir or _trace.json path")
+    ap.add_argument("--top", type=int, default=10,
+                    help="stalls to list (default 10)")
+    ap.add_argument("--merge", metavar="PROFILE_TRACE_DIR", default=None,
+                    help="also merge with a jax.profiler capture "
+                         "(profile_trace_dir=) into one Perfetto file")
+    ap.add_argument("--out", default=None,
+                    help="merged-trace output path (default: "
+                         "_trace_merged.json next to the input)")
+    args = ap.parse_args()
+
+    doc, path = load_host_trace(args.path)
+    events = doc["traceEvents"]
+    names = thread_names(events)
+    xs = complete_events(events)
+    other = doc.get("otherData", {})
+    dropped = other.get("dropped_events", 0)
+    print(f"{path}: {len(xs)} spans, {len(names)} threads"
+          + (f", {dropped} DROPPED (per-thread cap hit)" if dropped else ""))
+
+    print("\n== per-thread utilization ==")
+    for line in utilization_table(xs, names):
+        print(line)
+
+    print("\n== top stalls ==")
+    for line in top_stalls(xs, args.top):
+        print(line)
+
+    print("\n== per-video critical path ==")
+    lines, totals = critical_path(xs, names)
+    for line in lines:
+        print(line)
+    busy = {b: v for b, v in totals.items() if b != "stall"}
+    if any(busy.values()):
+        bottleneck = max(busy, key=busy.get)
+        total = sum(busy.values())
+        print(f"\nverdict: {bottleneck}-bound "
+              f"({busy[bottleneck] / total * 100:.0f}% of attributed busy "
+              "time" + (f"; + {totals['stall'] / 1e3:.1f} ms recorded "
+                        "stalls" if totals["stall"] else "") + ")")
+
+    if args.merge:
+        merged = merge_traces(doc, _load_device_trace(args.merge))
+        out = args.out or os.path.join(os.path.dirname(path),
+                                       "_trace_merged.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+        print(f"\nmerged host+device trace: {out} "
+              f"({len(merged['traceEvents'])} events) — open in "
+              "https://ui.perfetto.dev")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,374 @@
+"""Pipeline tracing (telemetry/trace.py + scripts/trace_report.py):
+recorder event shapes, the zero-cost disabled path, the StageProfiler
+trace hook, fan-out backpressure accounting, the span event cap, torn-
+file handling, profile_trace --self-time, and the CLI E2E contract
+(ISSUE 4 acceptance criteria)."""
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu import telemetry
+from video_features_tpu.telemetry import spans as tspans
+from video_features_tpu.telemetry import trace
+from video_features_tpu.telemetry.recorder import TelemetryRecorder
+from video_features_tpu.telemetry.trace import (REQUIRED_C_FIELDS,
+                                                REQUIRED_I_FIELDS,
+                                                REQUIRED_X_FIELDS,
+                                                TraceRecorder)
+from video_features_tpu.utils.profiling import profiler
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _events(doc, ph=None, name=None):
+    evs = doc["traceEvents"]
+    if ph is not None:
+        evs = [e for e in evs if e.get("ph") == ph]
+    if name is not None:
+        evs = [e for e in evs if e.get("name") == name]
+    return evs
+
+
+# -- recorder unit ----------------------------------------------------------
+
+def test_recorder_event_shapes_and_atomic_file(tmp_path):
+    rec = TraceRecorder(str(tmp_path)).start()
+    try:
+        assert trace.active() is rec
+        with trace.span("work", video="v.mp4", attempt=1):
+            time.sleep(0.002)
+        trace.complete("ext", time.perf_counter() - 0.01, 0.01, family="a")
+        trace.instant("marker", reason="x")
+        trace.counter("depth", 3)
+    finally:
+        path = rec.close()
+    assert trace.active() is None
+    assert path == str(tmp_path / "_trace.json")
+    # complete-or-absent: no temp files next to it
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["_trace.json"]
+    doc = json.load(open(path))
+
+    xs = _events(doc, "X")
+    assert {e["name"] for e in xs} == {"work", "ext"}
+    for e in xs:
+        assert all(k in e for k in REQUIRED_X_FIELDS), e
+    work = _events(doc, "X", "work")[0]
+    assert work["dur"] >= 2000  # ~2ms in µs
+    assert work["args"] == {"video": "v.mp4", "attempt": 1}
+    i = _events(doc, "i", "marker")[0]
+    assert all(k in i for k in REQUIRED_I_FIELDS)
+    c = _events(doc, "C", "depth")[0]
+    assert all(k in c for k in REQUIRED_C_FIELDS)
+    assert c["args"] == {"value": 3}
+    # metadata names the process and this thread
+    assert _events(doc, "M", "process_name")
+    tnames = [e["args"]["name"] for e in _events(doc, "M", "thread_name")]
+    assert threading.current_thread().name in tnames
+    other = doc["otherData"]
+    assert other["schema"] == "vft.trace/1"
+    assert other["dropped_events"] == 0
+    # close() is idempotent and the timeline is sorted by ts
+    assert rec.close() is None
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+
+
+def test_recorder_per_thread_buffers_and_cap(tmp_path):
+    rec = TraceRecorder(str(tmp_path), max_events_per_thread=3).start()
+    try:
+        def emit(n):
+            for i in range(n):
+                trace.instant(f"e{i}")
+
+        t = threading.Thread(target=emit, args=(5,), name="vft-test-emit")
+        t.start()
+        t.join()
+        emit(2)  # main thread: under its own cap
+    finally:
+        rec.close()
+    doc = json.load(open(tmp_path / "_trace.json"))
+    # the worker thread kept 3 of 5 and dropped 2; main kept both
+    assert doc["otherData"]["dropped_events"] == 2
+    assert len(_events(doc, "i")) == 5
+    tids = {e["args"]["name"]: e["tid"]
+            for e in _events(doc, "M", "thread_name")}
+    assert "vft-test-emit" in tids
+
+
+def test_trace_helpers_noop_when_inactive(tmp_path):
+    assert trace.active() is None
+    cm = trace.span("anything", video="v")
+    assert cm is trace.NOOP_TRACE_SPAN  # one shared object, no state
+    with cm:
+        pass
+    trace.instant("x")
+    trace.counter("y", 1)
+    trace.complete("z", time.perf_counter(), 0.1)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_stage_trace_hook_emits_and_uninstalls(tmp_path):
+    rec = TraceRecorder(str(tmp_path)).start()
+    try:
+        assert not profiler.enabled
+        with profiler.stage("decode"):
+            time.sleep(0.001)
+    finally:
+        rec.close()
+    assert profiler._trace_hook is None
+    assert profiler.snapshot() == {}  # aggregate printing stayed off
+    doc = json.load(open(tmp_path / "_trace.json"))
+    decode = _events(doc, "X", "decode")
+    assert len(decode) == 1 and decode[0]["dur"] >= 1000
+    # hook gone: stages stop emitting
+    with profiler.stage("decode"):
+        pass
+
+
+# -- fan-out backpressure accounting ---------------------------------------
+
+def test_fanout_backpressure_counters_and_heartbeat(tmp_path, sample_video):
+    """A tiny queue + slow consumer must show up as put-blocked time on
+    the bus side and land in the heartbeat's fanout section; the get
+    side accumulates starved time while waiting for decode."""
+    from video_features_tpu.parallel.fanout import FrameBus
+
+    rec = TelemetryRecorder(str(tmp_path / "out"), feature_type="x",
+                            interval_s=60.0, host_id="p0-t").start()
+    tracer = TraceRecorder(str(tmp_path / "out")).start()
+    try:
+        bus = FrameBus(sample_video, ["slow"], depth=2)
+        sub = bus.subscribe("slow", total=30)
+        frames = []
+        for x, ts, idx in sub.frames():
+            time.sleep(0.02)  # slow consumer: the 2-deep queue fills
+            frames.append(idx)
+        assert len(frames) == len(sub)
+        assert sub.put_blocked_s > 0  # the decoder waited on us
+        assert sub.get_starved_s >= 0
+        reg = rec.registry
+        assert reg.counter("vft_fanout_put_blocked_ms_total",
+                           family="slow").value > 0
+        fan = rec.fanout_snapshot()
+        assert "slow" in fan["queue_depth"]
+        assert fan["put_blocked_ms_total"]["slow"] > 0
+        hb = rec.build_heartbeat()
+        assert hb["fanout"]["put_blocked_ms_total"]["slow"] > 0
+    finally:
+        tracer.close()
+        rec.close()
+    doc = json.load(open(tmp_path / "out" / "_trace.json"))
+    names = {e["name"] for e in _events(doc, "X")}
+    assert "fanout.decode_pass" in names
+    assert "fanout.put_blocked" in names  # >=1ms stalls hit the timeline
+    tnames = [e["args"]["name"] for e in _events(doc, "M", "thread_name")]
+    assert "vft-fanout-decode" in tnames
+
+
+# -- span event cap (satellite) ---------------------------------------------
+
+def test_video_span_event_cap(tmp_path):
+    with tspans.VideoSpan("v.mp4") as span:
+        for i in range(tspans.MAX_SPAN_EVENTS + 40):
+            span.event("retry_tick", i=i)
+        span.event("ladder", to="inline")  # past the cap
+        span.annotate(status="done")
+    rec = span.record
+    events = rec["events"]
+    # first N kept + ONE drop-counter record; nothing unbounded
+    assert len(events) == tspans.MAX_SPAN_EVENTS + 1
+    assert events[-1]["kind"] == "events_dropped"
+    assert events[-1]["count"] == 41
+    # ladder_steps stays complete even past the cap
+    assert rec["ladder_steps"] == ["inline"]
+    from video_features_tpu.telemetry import schema as tschema
+    assert tschema.validate_span(rec) == []
+
+
+# -- trace_report.py --------------------------------------------------------
+
+def _write_trace(path, events, dropped=0):
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"schema": "vft.trace/1", "dropped_events": dropped}}
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def _report(args):
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "trace_report.py")]
+        + [str(a) for a in args], capture_output=True, text=True)
+
+
+def test_trace_report_verdict_and_stalls(tmp_path):
+    """Synthetic timeline: a decode-heavy video on a fanout bus thread
+    must report decode-bound and rank the injected stall."""
+    def x(name, ts, dur, tid, args=None):
+        e = {"ph": "X", "name": name, "ts": ts, "dur": dur, "pid": 1,
+             "tid": tid}
+        if args:
+            e["args"] = args
+        return e
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "vft-host"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 10,
+         "args": {"name": "vft-fanout-decode"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 20,
+         "args": {"name": "vft-family-resnet"}},
+        x("video_attempt", 0, 100_000, 20, {"video": "a.mp4",
+                                            "attempt": 1}),
+        x("decode", 0, 80_000, 10),            # bus lane: pure decode
+        x("decode", 10_000, 5_000, 20),        # family lane: transform
+        x("forward", 20_000, 10_000, 20),
+        x("write", 90_000, 2_000, 20),
+        x("fanout.get_starved", 40_000, 30_000, 20,
+          {"family": "resnet"}),
+    ]
+    p = _report([_write_trace(tmp_path / "_trace.json", events)])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "verdict: decode-bound" in p.stdout
+    assert "fanout.get_starved" in p.stdout
+    assert "a.mp4" in p.stdout
+    assert "vft-fanout-decode" in p.stdout
+    # accepts the run directory too
+    assert _report([tmp_path]).returncode == 0
+
+
+def test_trace_report_merge_host_device(tmp_path):
+    """--merge splices a jax.profiler-style device capture with the host
+    trace into one file, pids disjoint, both rebased to t=0."""
+    host = _write_trace(tmp_path / "_trace.json", [
+        {"ph": "X", "name": "decode", "ts": 5_000_000, "dur": 100,
+         "pid": 7, "tid": 1},
+    ])
+    dev_dir = tmp_path / "jaxtrace" / "plugins" / "profile" / "run1"
+    dev_dir.mkdir(parents=True)
+    (dev_dir / "host.trace.json").write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "ts": 9_000_000, "dur": 50,
+         "pid": 3, "tid": 2},
+    ]}))
+    p = _report([host, "--merge", tmp_path / "jaxtrace",
+                 "--out", tmp_path / "merged.json"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    merged = json.load(open(tmp_path / "merged.json"))
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"decode", "fusion.1"}
+    assert len({e["pid"] for e in xs}) == 2  # host pid remapped, disjoint
+    assert min(e["ts"] for e in xs) == 0  # both rebased
+
+
+def test_trace_report_truncated_file_clear_error(tmp_path):
+    torn = tmp_path / "_trace.json"
+    torn.write_text('{"traceEvents": [{"ph": "X", "name": "dec')  # torn
+    p = _report([torn])
+    assert p.returncode != 0
+    err = p.stdout + p.stderr
+    assert "not a complete JSON trace" in err
+    assert "Traceback" not in err  # a message, not a JSON traceback
+    # missing file: same discipline
+    p2 = _report([tmp_path / "absent"])
+    assert p2.returncode != 0 and "trace=true" in (p2.stdout + p2.stderr)
+    # JSON but not a trace
+    notrace = tmp_path / "x.json"
+    notrace.write_text('{"foo": 1}')
+    p3 = _report([notrace])
+    assert p3.returncode != 0
+    assert "traceEvents" in (p3.stdout + p3.stderr)
+
+
+# -- profile_trace --self-time (satellite) ----------------------------------
+
+def test_profile_trace_self_time_subtracts_children(tmp_path):
+    run = tmp_path / "plugins" / "profile" / "r1"
+    run.mkdir(parents=True)
+    (run / "h.trace.json").write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        # while-loop span (100us) containing two fusions (60us + 20us)
+        {"ph": "X", "name": "while", "ts": 0, "dur": 100, "pid": 1,
+         "tid": 1},
+        {"ph": "X", "name": "fusion.a", "ts": 5, "dur": 60, "pid": 1,
+         "tid": 1},
+        {"ph": "X", "name": "fusion.b", "ts": 70, "dur": 20, "pid": 1,
+         "tid": 1},
+    ]}))
+    script = str(REPO_ROOT / "scripts" / "profile_trace.py")
+
+    def run_tool(*flags):
+        p = subprocess.run([sys.executable, script, str(tmp_path)]
+                           + list(flags), capture_output=True, text=True)
+        assert p.returncode == 0, p.stdout + p.stderr
+        rows = {}
+        for line in p.stdout.splitlines():
+            parts = line.split()
+            if len(parts) == 3 and parts[2].startswith(("while", "fusion")):
+                rows[parts[2]] = float(parts[0]) * 1e3  # ms -> us
+        return rows
+
+    inclusive = run_tool()
+    assert inclusive["while"] == pytest.approx(100)
+    self_time = run_tool("--self-time")
+    assert self_time["while"] == pytest.approx(20)  # 100 - 60 - 20
+    assert self_time["fusion.a"] == pytest.approx(60)
+    assert sum(self_time.values()) == pytest.approx(100)  # sums to real
+
+
+# -- CLI E2E ----------------------------------------------------------------
+
+def test_cli_trace_end_to_end(tmp_path, sample_video):
+    """trace=true on a real (single-family) run: a valid trace with the
+    pipeline spans; trace=false leaves no _trace.json and an identical
+    telemetry footprint."""
+    from video_features_tpu import cli
+
+    def run(out, extra):
+        cli.main([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_total=6",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            f"output_path={tmp_path / out}", f"tmp_path={tmp_path}/tmp",
+            f"video_paths={sample_video}", "telemetry=true",
+            "metrics_interval_s=60"] + extra)
+        return tmp_path / out / "resnet" / "resnet18"
+
+    run_dir = run("traced", ["trace=true"])
+    doc = json.load(open(run_dir / "_trace.json"))
+    xs = _events(doc, "X")
+    for e in xs:
+        assert all(k in e for k in REQUIRED_X_FIELDS), e
+    names = {e["name"] for e in xs}
+    assert {"decode", "forward", "write", "video_attempt"} <= names
+    att = _events(doc, "X", "video_attempt")[0]
+    assert att["args"]["video"] == str(sample_video)
+    p = _report([run_dir])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "verdict:" in p.stdout
+
+    run_dir_off = run("plain", [])
+    assert not (run_dir_off / "_trace.json").exists()
+    # identical telemetry footprint with trace off: same artifact set,
+    # same per-video span record shape
+    on_files = {p.name for p in run_dir.iterdir()}
+    off_files = {p.name for p in run_dir_off.iterdir()}
+    assert on_files - off_files == {"_trace.json"}
+    from video_features_tpu.telemetry import jsonl as tjsonl
+    span_on = list(tjsonl.read_jsonl(run_dir / "_telemetry.jsonl"))[0]
+    span_off = list(tjsonl.read_jsonl(run_dir_off / "_telemetry.jsonl"))[0]
+    assert sorted(span_on) == sorted(span_off)
+    # ...and identical features
+    for npy in sorted(run_dir.glob("*.npy")):
+        np.testing.assert_array_equal(
+            np.load(npy), np.load(run_dir_off / npy.name),
+            err_msg=f"{npy.name} differs between trace on/off")

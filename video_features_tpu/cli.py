@@ -222,6 +222,16 @@ def main(argv: Optional[List[str]] = None) -> None:
             host_id=host_id,
         ).start()
 
+    # Pipeline tracing (trace=true): a Chrome-trace timeline of the host
+    # pipeline — every profiler.stage call, fan-out backpressure stall,
+    # prefetch and retry wait — drained to {out_root}/_trace.json at exit
+    # (telemetry/trace.py). Off by default: every trace helper is a
+    # one-global-read no-op, the same discipline as telemetry=false.
+    tracer = None
+    if bool(args.get("trace", False)):
+        from .telemetry.trace import TraceRecorder
+        tracer = TraceRecorder(out_root).start()
+
     def run_one(video_path: str) -> None:
         if stop.is_set():
             return
@@ -303,6 +313,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             recorder.close(tally=dict(tally),
                            wall_s=time.perf_counter() - t_run,
                            failure_tallies=by_cat)
+        if tracer is not None:
+            # likewise in the finally: an aborted run's partial timeline is
+            # still a complete, loadable trace file (atomic temp+rename)
+            tracer.close()
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())
@@ -351,6 +365,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"telemetry: {recorder.manifest_path} + {recorder.spans_path} "
               f"(render with scripts/telemetry_report.py "
               f"{out_root})")
+    if tracer is not None:
+        print(f"trace: {tracer.trace_path} (render with "
+              f"scripts/trace_report.py {out_root}, or open in "
+              "https://ui.perfetto.dev)")
     if profiler.enabled:
         print(profiler.summary(f"profile: {run_label} x "
                                f"{len(video_paths)} videos"))

@@ -317,6 +317,10 @@ def sanity_check(args: Config) -> None:
     if mi is not None and float(mi) <= 0:
         raise ValueError(f"metrics_interval_s={mi!r}: need a float > 0 "
                          "(the heartbeat/metrics flush period)")
+    tr = args.get("trace", False)
+    if not isinstance(tr, bool):
+        raise ValueError(f"trace={tr!r}: expected true or false (writes "
+                         "{output_path}/_trace.json, telemetry/trace.py)")
 
     fps_mode = args.get("fps_mode", "select") or "select"
     if fps_mode not in ("select", "reencode"):

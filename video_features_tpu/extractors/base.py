@@ -108,7 +108,12 @@ class BaseExtractor:
             kwargs.setdefault("fps_mode", "reencode")
             kwargs.setdefault("tmp_path", self.args.get("tmp_path", "tmp"))
             kwargs.setdefault("keep_tmp", self.keep_tmp_files)
-        src = cls(video_path, **kwargs)
+        from ..telemetry import trace as _trace
+        # probing can be slow (container metadata recount, reencode temp
+        # file, worker spawn): give it its own timeline span (no-op when
+        # trace=false)
+        with _trace.span("source_probe", video=str(video_path), mode=mode):
+            src = cls(video_path, **kwargs)
         if ctx is not None:
             ctx.register(src)
         # telemetry (no-ops without an active span): the source's probed

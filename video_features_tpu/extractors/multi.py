@@ -99,11 +99,17 @@ class MultiExtractor:
                                              depth=self.fanout_depth)
 
         def family_job(f: str) -> None:
+            from ..telemetry import trace
             ext = self.extractors[f]
             span_cm = (recorder.video_span(video_path, feature_type=f)
                        if recorder is not None else NOOP_SPAN)
             try:
-                with fanout.use_session(session):
+                # the family's whole per-video job as one timeline span:
+                # on its thread lane it brackets subscribe-wait, transform
+                # ("decode"), forward and write (trace=true; no-op off)
+                with fanout.use_session(session), \
+                        trace.span("family", family=f,
+                                   video=str(video_path)):
                     with span_cm as span:
                         status = sinks.safe_extract(
                             ext._extract, video_path,

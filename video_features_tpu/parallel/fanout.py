@@ -60,6 +60,8 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 import cv2
 import numpy as np
 
+from .. import telemetry
+from ..telemetry import trace
 from ..utils.faults import DeadlineExceeded
 from ..utils.io import (_batched, _FrameStream, count_frames_by_decode,
                         get_video_props, plan_frame_selection)
@@ -126,6 +128,15 @@ class SharedFrameSource:
         #: stream completed — the telemetry attribution field
         #: (``decode_shared_ms`` on the family's video span)
         self.decode_shared_ms: Optional[float] = None
+        #: cumulative backpressure seconds, both directions: the decoder
+        #: blocked on THIS family's full queue (put_blocked — this family
+        #: is the slow consumer holding everyone back) vs this family
+        #: blocked on an empty queue (get_starved — decode is the wall).
+        #: Mirrored into vft_fanout_*_ms_total{family=} counters and the
+        #: heartbeat "fanout" section; stalls past trace.STALL_MIN_S also
+        #: become timeline events.
+        self.put_blocked_s = 0.0
+        self.get_starved_s = 0.0
         # plan fields, set by the bus at finalize time
         self.fps: float = 0.0
         self.index_map: Optional[np.ndarray] = None
@@ -148,15 +159,43 @@ class SharedFrameSource:
     def _push(self, item) -> bool:
         """Bounded put that gives up when this subscriber is gone — one
         family abandoning its stream must never wedge the bus (and
-        thereby every other family)."""
+        thereby every other family). A put that found the queue full is
+        backpressure — the decoder outran this family — and is accounted
+        as put-blocked time (counter + trace span + depth gauge)."""
         import queue as _queue
+        try:
+            # uncontended fast path: a non-full queue costs no timing call
+            self.queue.put_nowait(item)
+            telemetry.gauge_set("vft_fanout_queue_depth",
+                                self.queue.qsize(), family=self.family)
+            return True
+        except _queue.Full:
+            pass
+        t0 = time.perf_counter()
+        ok = False
         while not self.closed:
             try:
                 self.queue.put(item, timeout=0.1)
-                return True
+                ok = True
+                break
             except _queue.Full:
                 continue
-        return False
+        self._account_put_blocked(t0)
+        if ok:
+            telemetry.gauge_set("vft_fanout_queue_depth",
+                                self.queue.qsize(), family=self.family)
+        return ok
+
+    def _account_put_blocked(self, t0: float) -> None:
+        dt = time.perf_counter() - t0
+        self.put_blocked_s += dt
+        telemetry.inc("vft_fanout_put_blocked_ms_total", dt * 1e3,
+                      family=self.family)
+        tr = trace.active()
+        if tr is not None and dt >= trace.STALL_MIN_S:
+            tr.complete("fanout.put_blocked", t0, dt, family=self.family)
+            tr.counter(f"fanout_queue_depth/{self.family}",
+                       self.queue.qsize())
 
     # -- consumer side ------------------------------------------------------
     def __len__(self) -> int:
@@ -176,25 +215,40 @@ class SharedFrameSource:
         try:
             while True:
                 self._raise_if_cancelled()
-                try:
-                    # 1s poll (not one long get) bounds how stale the
-                    # cancellation/liveness checks can be
-                    tag, payload = self.queue.get(timeout=1.0)
-                except _queue.Empty:
-                    t = self.bus._thread
-                    if t is not None and t.is_alive():
-                        continue
-                    self._raise_if_cancelled()
-                    # the bus may have flushed its tail and exited between
-                    # the timeout and the liveness check: drain first
+                t_wait = time.perf_counter()
+                while True:
                     try:
-                        tag, payload = self.queue.get_nowait()
+                        # 1s poll (not one long get) bounds how stale the
+                        # cancellation/liveness checks can be
+                        tag, payload = self.queue.get(timeout=1.0)
+                        break
                     except _queue.Empty:
-                        err = self._error
-                        raise RuntimeError(
-                            f"shared decode for {self.path} " +
-                            (f"failed: {err}" if err
-                             else "died without a result")) from None
+                        self._raise_if_cancelled()
+                        t = self.bus._thread
+                        if t is not None and t.is_alive():
+                            continue
+                        # the bus may have flushed its tail and exited
+                        # between the timeout and the liveness check:
+                        # drain first
+                        try:
+                            tag, payload = self.queue.get_nowait()
+                            break
+                        except _queue.Empty:
+                            err = self._error
+                            raise RuntimeError(
+                                f"shared decode for {self.path} " +
+                                (f"failed: {err}" if err
+                                 else "died without a result")) from None
+                # time spent inside get() is time THIS family sat idle
+                # waiting on the shared decoder (starvation)
+                waited = time.perf_counter() - t_wait
+                self.get_starved_s += waited
+                telemetry.inc("vft_fanout_get_starved_ms_total",
+                              waited * 1e3, family=self.family)
+                tr = trace.active()
+                if tr is not None and waited >= trace.STALL_MIN_S:
+                    tr.complete("fanout.get_starved", t_wait, waited,
+                                family=self.family)
                 if tag == "frame":
                     raw, out_idx = payload
                     with profiler.stage("decode"):
@@ -290,10 +344,19 @@ class FrameBus:
         if ctx is not None:
             ctx.register(sub)
         self._maybe_finalize()
+        t_wait = time.perf_counter()
         with self._cond:
             while not self._plans_ready and self._probe_error is None \
                     and not sub._cancelled:
                 self._cond.wait(0.1)
+            waited = time.perf_counter() - t_wait
+            tr = trace.active()
+            if tr is not None and waited >= trace.STALL_MIN_S:
+                # arrival-barrier stall: this family sat waiting for its
+                # siblings to subscribe (or the probe to finish) — the
+                # first suspect when a multi-family run's lanes start late
+                tr.complete("fanout.subscribe_wait", t_wait, waited,
+                            family=family)
             if sub._cancelled:
                 sub._raise_if_cancelled()
             if self._probe_error is not None:
@@ -387,6 +450,7 @@ class FrameBus:
         ptrs = {s.family: 0 for s in subs}
         emitted = {s.family: 0 for s in subs}
         finished: set = set()
+        t_pass = time.perf_counter()
         stream = _FrameStream(self.path, channel_order="bgr")
         with self._cond:
             self._stream = stream
@@ -485,6 +549,12 @@ class FrameBus:
             with self._cond:
                 self._stream = None
             stream.release()
+            # one umbrella span over the whole union pass: on the bus
+            # thread's lane it brackets the per-frame decode stage spans,
+            # and its gaps ARE the put-blocked stalls
+            trace.complete("fanout.decode_pass", t_pass,
+                           time.perf_counter() - t_pass, video=self.path,
+                           families=len(subs))
 
 
 class SharedDecodeSession:
@@ -530,7 +600,9 @@ class SharedDecodeSession:
                                    f"{video_path}: {self._wav_error}")
             if self._wav is None:
                 try:
-                    self._wav = ripper(video_path, tmp_path)
+                    with trace.span("wav_rip", video=str(video_path),
+                                    shared=True):
+                        self._wav = ripper(video_path, tmp_path)
                 except BaseException as e:
                     self._wav_error = f"{type(e).__name__}: {e}"
                     raise

@@ -64,6 +64,14 @@ def observe(name: str, value: float, buckets=None, **labels: Any) -> None:
         r.registry.histogram(name, buckets=buckets, **labels).observe(value)
 
 
+def gauge_set(name: str, value: float, **labels: Any) -> None:
+    """Set a gauge on the active recorder's registry — the fan-out
+    queue-depth sampling path (parallel/fanout.py)."""
+    r = _active
+    if r is not None:
+        r.registry.gauge(name, **labels).set(value)
+
+
 def annotate(**kw: Any) -> None:
     """Set attributes on this thread's current video span, if any."""
     s = current_span()

@@ -233,7 +233,32 @@ class TelemetryRecorder:
             "videos_per_s": vps,
             "last_video": last_video,
             "stage_delta": delta,
+            # fan-out backpressure (parallel/fanout.py): per-family queue
+            # depth gauges + cumulative blocked/starved totals, so a
+            # heartbeat reader can tell WHICH family is the slow consumer
+            # (its queue runs full, put_blocked grows) or the starved one
+            # (its queue runs empty, get_starved grows) without the trace
+            "fanout": self.fanout_snapshot(),
         }
+
+    def fanout_snapshot(self) -> dict:
+        """Per-family fan-out backpressure series pulled out of the
+        registry: ``{queue_depth, put_blocked_ms_total,
+        get_starved_ms_total}``, each ``{family: value}`` (empty dicts
+        outside multi-family runs)."""
+        out: Dict[str, Dict[str, float]] = {
+            "queue_depth": {}, "put_blocked_ms_total": {},
+            "get_starved_ms_total": {}}
+        key_of = {"vft_fanout_queue_depth": "queue_depth",
+                  "vft_fanout_put_blocked_ms_total": "put_blocked_ms_total",
+                  "vft_fanout_get_starved_ms_total": "get_starved_ms_total"}
+        for s in self.registry.to_dict()["series"]:
+            key = key_of.get(s["name"])
+            fam = s.get("labels", {}).get("family")
+            if key is None or fam is None:
+                continue
+            out[key][fam] = round(float(s.get("value", 0.0)), 3)
+        return out
 
     def write_heartbeat(self, final: bool = False) -> None:
         jsonl.write_json_atomic(self.heartbeat_path,

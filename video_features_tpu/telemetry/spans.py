@@ -36,6 +36,13 @@ SCHEMA_VERSION = "vft.video_span/1"
 #: terminal statuses, mirroring safe_extract's return values
 STATUSES = ("done", "skipped", "error", "quarantined")
 
+#: cap on per-span timeline events: the first N are kept verbatim, the
+#: overflow is counted and reported as one final ``events_dropped``
+#: record. A pathological retry loop (or a future instrumentation bug)
+#: must never grow a span's in-memory record without bound before it
+#: serializes — spans are per-video observations, not logs.
+MAX_SPAN_EVENTS = 256
+
 #: exactly the top-level keys of every emitted record, in emit order —
 #: scripts/check_telemetry_schema.py asserts these equal the JSON
 #: Schema's properties, and tests validate emitted records against both
@@ -87,6 +94,7 @@ class VideoSpan:
         self._attrs: Dict[str, Any] = {}
         self._stages: Dict[str, List[float]] = {}  # name -> [seconds, calls]
         self._events: List[dict] = []
+        self._events_dropped = 0
         self._ladder: List[str] = []
         self._t0 = time.perf_counter()
         self._start_time = time.time()
@@ -112,12 +120,19 @@ class VideoSpan:
 
     def event(self, kind: str, **kw: Any) -> None:
         """Append a timeline event (retry, ladder, quarantine, source...)
-        stamped with seconds-since-span-start."""
+        stamped with seconds-since-span-start. Capped at
+        :data:`MAX_SPAN_EVENTS` (first N kept, overflow counted) so a
+        runaway retry loop cannot grow the record without bound."""
         rec = {"kind": str(kind),
                "t": round(time.perf_counter() - self._t0, 4)}
         rec.update(kw)
         with self._lock:
-            self._events.append(rec)
+            if len(self._events) < MAX_SPAN_EVENTS:
+                self._events.append(rec)
+            else:
+                self._events_dropped += 1
+            # ladder_steps stays complete past the cap: it is its own
+            # bounded field (one entry per demotion, ladder depth <= 2)
             if kind == "ladder":
                 to = kw.get("to")
                 if to is not None:
@@ -137,7 +152,11 @@ class VideoSpan:
             stages = {k: {"s": round(v[0], 6), "calls": int(v[1])}
                       for k, v in self._stages.items()}
             events = list(self._events)
+            dropped = self._events_dropped
             ladder = list(self._ladder)
+        if dropped:
+            events.append({"kind": "events_dropped", "count": int(dropped),
+                           "t": round(wall, 4)})
         status = attrs.get("status")
         if status not in STATUSES:
             # an exception propagated past safe_extract (KeyboardInterrupt,

@@ -974,6 +974,7 @@ class Prefetcher:
     def __iter__(self):
         import queue as _queue
         import threading
+        import time as _time
 
         q: "_queue.Queue" = _queue.Queue(maxsize=self.depth)
         stop = threading.Event()
@@ -989,17 +990,39 @@ class Prefetcher:
             return False
 
         def produce():
+            from ..telemetry import trace as _trace
             from ..telemetry import use_span
             try:
                 with use_span(self._span):
-                    for item in self.iterable:
+                    it = iter(self.iterable)
+                    while True:
+                        # tracing (no-op when off): `prefetch.next` spans
+                        # bracket this thread's decode+transform of one
+                        # batch; a blocked put means the CONSUMER fell
+                        # behind (device-bound), the dual of starved-get
+                        tr = _trace.active()
+                        t0 = _time.perf_counter() if tr is not None else 0.0
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
+                        if tr is not None:
+                            tr.complete("prefetch.next", t0,
+                                        _time.perf_counter() - t0)
+                        t1 = _time.perf_counter()
                         if not put_until_stopped(item):
                             return
+                        if tr is not None:
+                            blocked = _time.perf_counter() - t1
+                            if blocked >= _trace.STALL_MIN_S:
+                                tr.complete("prefetch.put_blocked", t1,
+                                            blocked)
                 put_until_stopped(self._DONE)
             except BaseException as e:  # re-raised consumer-side
                 put_until_stopped(e)
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(target=produce, name="vft-prefetch",
+                             daemon=True)
         t.start()
         try:
             while True:
@@ -1061,10 +1084,13 @@ def extract_wav_from_mp4(video_path: Union[str, Path],
     stem = Path(video_path).stem
     aac = str(tmp / f"{stem}.aac")
     wav = str(tmp / f"{stem}.wav")
-    for cmd in (
-        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i",
-         video_path, "-acodec", "copy", aac],
-        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i", aac, wav],
-    ):
-        subprocess.run(cmd, check=True)
+    from ..telemetry import trace
+    with trace.span("wav_rip", video=video_path):
+        for cmd in (
+            [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i",
+             video_path, "-acodec", "copy", aac],
+            [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i", aac,
+             wav],
+        ):
+            subprocess.run(cmd, check=True)
     return wav, aac

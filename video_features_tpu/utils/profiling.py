@@ -43,6 +43,8 @@ class StageProfiler:
         self._times: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
         self._hook: Optional[Callable[[str, float], None]] = None
+        self._trace_hook: Optional[Callable[[str, float, float],
+                                            None]] = None
 
     def set_hook(self, hook: Optional[Callable[[str, float], None]]) -> None:
         """Install (or clear, with None) a per-observation callback
@@ -50,10 +52,19 @@ class StageProfiler:
         Timing happens whenever ``enabled`` OR a hook is present."""
         self._hook = hook
 
+    def set_trace_hook(self, hook: Optional[Callable[[str, float, float],
+                                                     None]]) -> None:
+        """Install (or clear) ``hook(stage_name, t0_perf, seconds)`` —
+        the trace recorder's feed (telemetry/trace.py). Unlike the
+        aggregate hook it receives the START time too, so each stage
+        call becomes one complete timeline event."""
+        self._trace_hook = hook
+
     @contextmanager
     def stage(self, name: str) -> Iterator[None]:
         hook = self._hook
-        if not self.enabled and hook is None:
+        trace_hook = self._trace_hook
+        if not self.enabled and hook is None and trace_hook is None:
             yield
             return
         t0 = time.perf_counter()
@@ -70,6 +81,11 @@ class StageProfiler:
                     hook(name, dt)
                 except Exception:
                     pass  # observability must never fail the pipeline
+            if trace_hook is not None:
+                try:
+                    trace_hook(name, t0, dt)
+                except Exception:
+                    pass
 
     def add(self, name: str, dt: float, n: int = 1) -> None:
         """Accumulate an externally-timed observation (the telemetry

@@ -187,6 +187,7 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
     """
     from . import faults
     from .. import telemetry
+    from ..telemetry import trace
 
     if policy is None:
         policy = faults.RetryPolicy()  # single attempt, no deadline
@@ -216,8 +217,13 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                                   deadline_s=policy.deadline_s,
                                   decode_override=override)
         try:
-            with ctx:
-                result = extract_fn(video_path)
+            # one timeline span per attempt (trace=true; no-op otherwise):
+            # the unit trace_report.py cuts the per-video critical path on,
+            # recorded for failed attempts too
+            with trace.span("video_attempt", video=str(video_path),
+                            attempt=attempt):
+                with ctx:
+                    result = extract_fn(video_path)
             if attempt > 1:
                 print(f'Recovered "{video_path}" on attempt '
                       f"{attempt}/{policy.attempts}"
@@ -255,7 +261,10 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
                 telemetry.inc("vft_video_retries_total")
                 if delay > 0:
                     print(f"Retrying \"{video_path}\" in {delay:.2f}s ...")
-                    policy.sleep(delay)
+                    with trace.span("retry_backoff", video=str(video_path),
+                                    attempt=attempt,
+                                    delay_s=round(delay, 3)):
+                        policy.sleep(delay)
 
     elapsed = policy.clock() - t0
     telemetry.annotate(attempts=attempts_made, category=category,

@@ -461,6 +461,52 @@ def bench_trace_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_health_overhead(families=("resnet", "clip", "s3d"),
+                          n_copies: int = 2) -> dict:
+    """Wall-clock cost of health=true (telemetry/health.py) on the same
+    smoke corpus as bench_trace_overhead: the multi-family CLI run,
+    warmed untimed, then timed with health=false and health=true into
+    fresh output dirs. The digests (O(n) reductions + one sha256 per
+    feature tensor, at the sink boundary) are the instrumented path; the
+    acceptance bar is <= 1.05x, tracked per round like the trace ratio."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the health bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_health_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_health{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", ["health=false"])
+        on = run("on", ["health=true"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_i3d_torch(stack: int = I3D_STACK) -> float:
     """The full reference-shaped stack unit in torch on this host's CPU:
     RAFT flow on the frame pairs PLUS both I3D tower forwards (all classes
@@ -981,6 +1027,28 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: trace-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # health=true wall-clock tax (telemetry/health.py digests at the sink
+    # boundary): same <= 1.05x acceptance bar as the trace ratio, tracked
+    # per round; scripts/bench_history.py flags it when it creeps
+    try:
+        ho = bench_health_overhead()
+        metrics.append({
+            "metric": "output health overhead (health=true vs off, "
+                      f"{'+'.join(ho['families'])})",
+            "value": ho["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": ho["off_s"],
+            "on_s": ho["on_s"],
+            "note": f"{ho['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs; per-feature digests (stats + sha256 "
+                    "content signature) at the sink boundary are the "
+                    "instrumented path (docs/observability.md 'Output "
+                    "health & comparing runs')",
+        })
+    except Exception as e:
+        print(f"WARNING: health-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
 
     # Full-fidelity record (notes, baselines, every row) goes to a repo

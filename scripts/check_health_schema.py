@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Health quick-gate: emitter and JSON Schema agree, and a real
+``health=true`` CPU smoke emits valid digests.
+
+Third sibling of ``check_telemetry_schema.py`` (static span pinning) and
+``check_trace_schema.py`` (dynamic trace pinning), for the output-health
+pillar (telemetry/health.py). Two halves:
+
+  1. **static**: ``feature_health.schema.json`` properties ==
+     ``HEALTH_FIELDS``; ``required`` is a subset; the schema tag enum
+     matches; a synthetic digest (healthy + NaN/Inf tensors) has exactly
+     the declared keys and validates via the dependency-free validator
+     (telemetry/schema.py);
+  2. **dynamic**: a single-family resnet CPU smoke over the vendored
+     sample with ``health=true telemetry=true`` must append one valid
+     record per output key to ``_health.jsonl``, report zero non-finite
+     values, and roll the digests up into the ``_run.json`` manifest's
+     ``health`` section.
+
+Exit 0 = in sync; exit 1 = drift, every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml).
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import numpy as np  # noqa: E402
+
+from video_features_tpu.telemetry import health  # noqa: E402
+from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+
+def check_static() -> List[str]:
+    errs: List[str] = []
+    try:
+        sch = health.load_health_schema()
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"cannot load {health.HEALTH_SCHEMA_PATH}: "
+                f"{type(e).__name__}: {e}"]
+    props = set(sch.get("properties", {}))
+    fields = set(health.HEALTH_FIELDS)
+    if props != fields:
+        only_schema = sorted(props - fields)
+        only_emitter = sorted(fields - props)
+        if only_schema:
+            errs.append(f"schema-only properties (emitter never writes "
+                        f"them): {only_schema}")
+        if only_emitter:
+            errs.append(f"emitter fields missing from schema: "
+                        f"{only_emitter}")
+    missing_req = sorted(set(sch.get("required", [])) - props)
+    if missing_req:
+        errs.append(f"required keys not in properties: {missing_req}")
+    tag_enum = sch.get("properties", {}).get("schema", {}).get("enum")
+    if tag_enum != [health.SCHEMA_VERSION]:
+        errs.append(f"schema tag enum {tag_enum} != "
+                    f"[{health.SCHEMA_VERSION!r}]")
+    if sch.get("additionalProperties", True) is not False:
+        errs.append("schema must set additionalProperties: false "
+                    "(the record contract is closed)")
+
+    # synthetic digests: a healthy tensor and a NaN/Inf one both emit
+    # exactly HEALTH_FIELDS and validate
+    good = np.linspace(-1, 1, 24, dtype=np.float32).reshape(4, 6)
+    bad = good.copy()
+    bad[0, 0], bad[1, 1] = np.nan, np.inf
+    for name, arr in (("good", good), ("bad", bad)):
+        rec = health.digest_array("feat", arr, video="check.mp4",
+                                  feature_type="check")
+        if set(rec) != fields:
+            errs.append(f"{name} record keys {sorted(set(rec) ^ fields)} "
+                        "differ from HEALTH_FIELDS")
+        errs.extend(f"{name}: {e}" for e in health.validate_health(rec))
+    if health.digest_array("f", bad, video="v", feature_type="c")["nan"] \
+            != 1:
+        errs.append("NaN count wrong on the synthetic bad tensor")
+    return errs
+
+
+def check_smoke() -> List[str]:
+    if not SAMPLE.exists():
+        print(f"health smoke SKIP: vendored sample missing at {SAMPLE}")
+        return []
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="vft_health_gate_") as td:
+        out, tmp = Path(td) / "out", Path(td) / "tmp"
+        with contextlib.redirect_stdout(sys.stderr):
+            cli_main([
+                "feature_type=resnet", "model_name=resnet18", "device=cpu",
+                "allow_random_weights=true", "on_extraction=save_numpy",
+                "batch_size=8", "extraction_total=6", "retry_attempts=1",
+                f"output_path={out}", f"tmp_path={tmp}",
+                f"video_paths={SAMPLE}",
+                "health=true", "telemetry=true", "metrics_interval_s=60",
+            ])
+        run_dir = out / "resnet" / "resnet18"
+        hpath = run_dir / health.HEALTH_FILENAME
+        if not hpath.exists():
+            return [f"{hpath} was not written by the health=true smoke"]
+        recs = list(read_jsonl(hpath))
+        if not recs:
+            errs.append(f"{hpath} holds no parseable records")
+        for i, rec in enumerate(recs):
+            for e in health.validate_health(rec):
+                errs.append(f"record #{i}: {e}")
+            if set(rec) != set(health.HEALTH_FIELDS):
+                errs.append(f"record #{i} keys differ from HEALTH_FIELDS")
+            if rec.get("nan") or rec.get("inf"):
+                errs.append(f"record #{i}: smoke features came out "
+                            f"non-finite ({rec.get('nan')} NaN / "
+                            f"{rec.get('inf')} Inf)")
+        manifests = glob.glob(str(run_dir / "_run.json"))
+        if not manifests:
+            errs.append("no _run.json manifest from the smoke run")
+        else:
+            man = json.load(open(manifests[0]))
+            rollup = man.get("health")
+            if not rollup or "resnet" not in rollup:
+                errs.append("manifest 'health' roll-up missing the "
+                            f"resnet family (got {rollup!r})")
+            elif rollup["resnet"].get("records", 0) != len(recs):
+                errs.append(
+                    f"manifest roll-up counts {rollup['resnet']} do not "
+                    f"match the {len(recs)} _health.jsonl record(s)")
+    return errs
+
+
+def main() -> int:
+    errs = check_static()
+    if not errs:
+        errs += check_smoke()
+    if errs:
+        print("health schema/emitter DRIFT:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"health gate OK: {len(health.HEALTH_FIELDS)} fields in sync "
+          f"({health.HEALTH_SCHEMA_PATH}); health=true smoke emitted "
+          "valid digests + manifest roll-up")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,7 +33,13 @@ from video_features_tpu.telemetry import spans  # noqa: E402
 
 def check() -> List[str]:
     errs: List[str] = []
-    sch = tschema.load_span_schema()
+    try:
+        sch = tschema.load_span_schema()
+    except Exception as e:
+        # a torn/empty/missing schema file is itself maximal drift: report
+        # it as a violation instead of dying with a traceback
+        return [f"cannot load {tschema.SPAN_SCHEMA_PATH}: "
+                f"{type(e).__name__}: {e}"]
     props = set(sch.get("properties", {}))
     fields = set(spans.SPAN_FIELDS)
 

@@ -127,7 +127,13 @@ def check(out: Path) -> List[str]:
     if not hbs:
         errs.append("no heartbeat file written")
     else:
-        hb = json.load(open(hbs[0]))
+        try:
+            hb = json.load(open(hbs[0]))
+        except (OSError, json.JSONDecodeError) as e:
+            # a torn heartbeat is a finding (the atomic-replace contract
+            # broke), not a traceback
+            return errs + [f"{hbs[0]} is not valid JSON ({e}) — "
+                           "write_json_atomic contract broke"]
         fan = hb.get("fanout")
         if not isinstance(fan, dict):
             errs.append("heartbeat has no 'fanout' section")

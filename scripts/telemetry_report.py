@@ -31,12 +31,13 @@ import os
 import sys
 import time
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from video_features_tpu.telemetry.heartbeat import (HEARTBEAT_GLOB,  # noqa: E402
-                                                    STALL_INTERVALS)
+                                                    STALL_INTERVALS,
+                                                    matches_run)
 from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
 from video_features_tpu.telemetry.metrics import prometheus_text  # noqa: E402
 from video_features_tpu.telemetry.recorder import SPANS_FILENAME  # noqa: E402
@@ -64,6 +65,7 @@ def render_manifest(man: dict) -> List[str]:
     topo = man.get("topology", {})
     lines.append(
         f"  feature_type={man.get('feature_type')}  host={man.get('host')}"
+        f"  run_id={man.get('run_id')}"
         f"  wall={man.get('wall_s')}s  videos/s={man.get('videos_per_s')}")
     lines.append(
         f"  git={str(man.get('git', {}).get('commit'))[:12]}"
@@ -81,6 +83,12 @@ def render_manifest(man: dict) -> List[str]:
     if cc:
         lines.append(f"  compile cache: {cc.get('hits', 0)} hits / "
                      f"{cc.get('misses', 0)} misses")
+    for fam, h in sorted((man.get("health") or {}).items()):
+        bad = h.get("nonfinite_records", 0)
+        lines.append(
+            f"  health[{fam}]: {h.get('records', 0)} digests, "
+            f"{h.get('nan', 0)} NaN / {h.get('inf', 0)} Inf"
+            + (f"  ({bad} NON-FINITE record(s))" if bad else ""))
     totals = man.get("stage_totals", {})
     if totals:
         acc = sum(v.get("s", 0.0) for v in totals.values()) or 1.0
@@ -93,7 +101,9 @@ def render_manifest(man: dict) -> List[str]:
     return lines
 
 
-def render_heartbeats(paths: List[str], now: float) -> List[str]:
+def render_heartbeats(paths: List[str], now: float,
+                      run_id: Optional[str] = None,
+                      started_time: Optional[float] = None) -> List[str]:
     lines = ["== heartbeats =="]
     if not paths:
         return lines + ["  (none)"]
@@ -101,6 +111,13 @@ def render_heartbeats(paths: List[str], now: float) -> List[str]:
         hb = _load_json(p)
         if hb is None:
             lines.append(f"  {os.path.basename(p)}: unreadable")
+            continue
+        if not matches_run(hb, run_id, started_time):
+            # a prior run of the same output_path left this file behind;
+            # counting it would invent a stalled/dead worker (or sum a
+            # dead run's stage deltas into this one)
+            lines.append(f"  {hb.get('host_id')}: PRIOR RUN (run_id="
+                         f"{hb.get('run_id')}) — ignored")
             continue
         age = max(0.0, now - float(hb.get("time", now)))
         interval = float(hb.get("interval_s", 30.0)) or 30.0
@@ -155,15 +172,24 @@ def render_spans(spans: List[dict], slowest: int) -> List[str]:
     return lines
 
 
-def render_failures(path: str) -> List[str]:
-    tallies: Dict[str, int] = {}
+def render_failures(path: str) -> Tuple[List[str], Dict[str, int]]:
+    """(report lines, gating tallies). Gating uses the journal's
+    last-record-wins-per-video contract (utils/faults.py): a video whose
+    quarantine was later RESOLVED does not count against
+    ``--fail-on-failures``."""
+    latest: Dict[str, str] = {}
     for rec in read_jsonl(path):
-        cat = rec.get("category", "?")
+        latest[str(rec.get("video"))] = rec.get("category", "?")
+    tallies: Dict[str, int] = {}
+    for cat in latest.values():
         tallies[cat] = tallies.get(cat, 0) + 1
-    if not tallies:
-        return []
-    return ["== fault journal (_failures.jsonl) ==",
-            "  " + ", ".join(f"{k}={v}" for k, v in sorted(tallies.items()))]
+    resolved = tallies.pop("RESOLVED", 0)
+    if not tallies and not resolved:
+        return [], tallies
+    line = "  " + ", ".join(f"{k}={v}" for k, v in sorted(tallies.items()))
+    if resolved:
+        line += f"{', ' if tallies else ''}RESOLVED={resolved}"
+    return ["== fault journal (_failures.jsonl) ==", line], tallies
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -174,6 +200,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "manifest's metrics dump")
     ap.add_argument("--slowest", type=int, default=5,
                     help="how many slowest/failed videos to list")
+    ap.add_argument("--fail-on-failures", action="store_true",
+                    help="exit 1 when _failures.jsonl holds any terminal "
+                         "failure — lets shell pipelines gate on run "
+                         "health (vft ... && telemetry_report.py OUT "
+                         "--fail-on-failures && deploy)")
     args = ap.parse_args(argv)
     out = args.output_dir
     if not os.path.isdir(out):
@@ -189,10 +220,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         lines += ["== run manifest (_run.json) ==",
                   "  absent (run still in flight, or telemetry=false)"]
     lines += render_heartbeats(
-        glob.glob(os.path.join(out, HEARTBEAT_GLOB)), now)
+        glob.glob(os.path.join(out, HEARTBEAT_GLOB)), now,
+        run_id=(man or {}).get("run_id"),
+        started_time=(man or {}).get("started_time"))
     spans = list(read_jsonl(os.path.join(out, SPANS_FILENAME)))
     lines += render_spans(spans, args.slowest)
-    lines += render_failures(os.path.join(out, "_failures.jsonl"))
+    failure_lines, failure_tallies = render_failures(
+        os.path.join(out, "_failures.jsonl"))
+    lines += failure_lines
     print("\n".join(lines))
 
     if args.prom:
@@ -201,6 +236,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             f.write(prometheus_text(dump))
         print(f"prometheus textfile: {args.prom} "
               f"({len(dump.get('series', []))} series)")
+    if args.fail_on_failures and failure_tallies:
+        n = sum(failure_tallies.values())
+        print(f"fail-on-failures: {n} journal record(s) "
+              f"({', '.join(f'{k}={v}' for k, v in sorted(failure_tallies.items()))})",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -1,0 +1,343 @@
+"""Output-health pillar: feature digests, the non-finite POISON gate,
+run comparison, bench history, artifact sha events and the report's
+fail-on-failures gate (ISSUE 5)."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.telemetry import health
+from video_features_tpu.telemetry import jsonl as tjsonl
+from video_features_tpu.utils import faults, sinks
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SCRIPTS = REPO_ROOT / "scripts"
+
+
+# -- digests ----------------------------------------------------------------
+
+def test_digest_array_stats_and_counts():
+    a = np.array([[1.0, -2.0], [3.0, 0.0]], dtype=np.float32)
+    r = health.digest_array("k", a, video="v.mp4", feature_type="resnet")
+    assert r["schema"] == health.SCHEMA_VERSION
+    assert r["shape"] == [2, 2] and r["dtype"] == "float32"
+    assert r["elems"] == 4 and r["nan"] == 0 and r["inf"] == 0
+    assert r["min"] == -2.0 and r["max"] == 3.0
+    assert r["mean"] == pytest.approx(0.5)
+    assert r["l2"] == pytest.approx(np.sqrt(14.0))
+    assert set(r) == set(health.HEALTH_FIELDS)
+    assert health.validate_health(r) == []
+
+
+def test_digest_nonfinite_counts_and_finite_stats():
+    a = np.ones((3, 3), dtype=np.float32)
+    a[0, 0] = np.nan
+    a[1, 1] = np.inf
+    a[2, 2] = -np.inf
+    r = health.digest_array("k", a, video="v", feature_type="raft")
+    assert r["nan"] == 1 and r["inf"] == 2
+    # stats cover the finite values only — NaN must not poison them
+    assert r["min"] == r["max"] == r["mean"] == 1.0
+    assert health.validate_health(r) == []
+
+
+def test_content_signature_quantization_tolerance():
+    rng = np.random.default_rng(3)
+    # bucket-center values: the signature's tolerance guarantee is
+    # probabilistic (a value already straddling a SIG_GRID bucket edge
+    # can flip on any jitter — compare_runs' stat bands are the
+    # authoritative drift measure), so the deterministic test pins the
+    # center-of-bucket case
+    a = (rng.integers(-200, 200, (16, 64)) *
+         health.SIG_GRID).astype(np.float32)
+    sig = health.content_signature(a)
+    # sub-tolerance jitter (bf16-noise scale) hashes identically
+    assert health.content_signature(a + 1e-5) == sig
+    # a shift past the value tier's atol=1e-2 changes it
+    assert health.content_signature(a + 0.063) != sig
+    # and so does a NaN
+    b = a.copy()
+    b[0, 0] = np.nan
+    assert health.content_signature(b) != sig
+    # shape participates: a reshape of identical bytes is a different sig
+    assert health.content_signature(a.reshape(32, 32)) != sig
+
+
+def test_digest_features_appends_jsonl(tmp_path):
+    feats = {"feat": np.arange(6, dtype=np.float32),
+             "logits": np.ones((2, 3), dtype=np.float32)}
+    recs = health.digest_features(feats, "v.mp4", "s3d", str(tmp_path))
+    assert len(recs) == 2
+    on_disk = list(tjsonl.read_jsonl(tmp_path / health.HEALTH_FILENAME))
+    assert [r["key"] for r in on_disk] == ["feat", "logits"]
+    assert all(health.validate_health(r) == [] for r in on_disk)
+
+
+# -- the non-finite gate routes through the faults taxonomy -----------------
+
+def test_check_features_raises_poison_and_journals(tmp_path):
+    bad = np.ones(4, dtype=np.float32)
+    bad[2] = np.nan
+    with pytest.raises(health.NonFiniteFeatureError) as ei:
+        health.check_features({"feat": bad}, "v.mp4", "raft", str(tmp_path))
+    assert faults.classify(ei.value) == faults.POISON
+    # the digest of the bad tensor was journaled BEFORE the raise
+    recs = list(tjsonl.read_jsonl(tmp_path / health.HEALTH_FILENAME))
+    assert recs and recs[0]["nan"] == 1
+
+    # end to end: safe_extract quarantines it via the journal
+    journal = faults.FailureJournal(str(tmp_path))
+
+    def extract(video_path):
+        health.check_features({"feat": bad}, video_path, "raft",
+                              str(tmp_path))
+        return {"feat": bad}
+
+    policy = faults.RetryPolicy(attempts=2, backoff_s=0.0,
+                                sleep=lambda s: None)
+    assert sinks.safe_extract(extract, "v.mp4", policy=policy,
+                              journal=journal) == "error"
+    assert journal.poison_record("v.mp4") is not None  # quarantined
+    assert sinks.safe_extract(extract, "v.mp4", policy=policy,
+                              journal=journal) == "quarantined"
+
+
+def test_worker_forwarded_nonfinite_string_classifies_poison():
+    # the decode-subprocess protocol ships f"{type}: {msg}" RuntimeErrors
+    e = RuntimeError("NonFiniteFeatureError: non-finite feature values")
+    assert faults.classify(e) == faults.POISON
+
+
+# -- artifact digests (hash-before-rename) in sinks -------------------------
+
+def test_writers_return_bytes_and_sha_on_request(tmp_path):
+    arr = np.arange(12, dtype=np.float32)
+    npy = str(tmp_path / "a_feat.npy")
+    assert sinks.write_numpy(npy, arr) is None  # default: no digest work
+    info = sinks.write_numpy(npy, arr, want_digest=True)
+    assert info is not None and info[0] == os.path.getsize(npy)
+    import hashlib
+    assert info[1] == hashlib.sha256(open(npy, "rb").read()).hexdigest()
+    np.testing.assert_array_equal(sinks.load_numpy(npy), arr)
+
+    pkl = str(tmp_path / "a_feat.pkl")
+    info = sinks.write_pickle(pkl, {"x": arr}, want_digest=True)
+    assert info[0] == os.path.getsize(pkl)
+    assert info[1] == hashlib.sha256(open(pkl, "rb").read()).hexdigest()
+    assert [p.name for p in tmp_path.iterdir()] == \
+        sorted(["a_feat.npy", "a_feat.pkl"])  # no temp junk
+
+
+def test_action_on_extraction_emits_artifact_events(tmp_path):
+    from video_features_tpu.telemetry.spans import VideoSpan
+    feats = {"feat": np.ones((2, 4), dtype=np.float32)}
+    with VideoSpan("v.mp4", feature_type="resnet") as span:
+        sinks.action_on_extraction(feats, "v.mp4", str(tmp_path),
+                                   "save_numpy")
+        span.annotate(status="done")
+    events = [e for e in span.record["events"] if e["kind"] == "artifact"]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["file"] == "v_feat.npy"
+    assert ev["bytes"] == os.path.getsize(tmp_path / "v_feat.npy")
+    assert len(ev["sha256"]) == 64
+    from video_features_tpu.telemetry import schema as tschema
+    assert tschema.validate_span(span.record) == []
+
+
+# -- compare_runs -----------------------------------------------------------
+
+def test_compare_runs_selftest_fixture():
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import compare_runs
+    finally:
+        sys.path.pop(0)
+    assert compare_runs.selftest() == 0
+
+
+def test_compare_runs_stage_and_failure_deltas(tmp_path):
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import compare_runs
+    finally:
+        sys.path.pop(0)
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d, decode_ms, fail in ((a, 10.0, False), (b, 30.0, True)):
+        d.mkdir()
+        tjsonl.write_json_atomic(d / "_run.json", {
+            "stage_totals": {"decode": {"s": decode_ms, "calls": 1000}}})
+        if fail:
+            tjsonl.append_jsonl(d / "_failures.jsonl", {
+                "video": "bad.mp4", "category": "POISON", "attempts": 3,
+                "error": "x"})
+    rc, lines = compare_runs.compare(str(a), str(b))
+    text = "\n".join(lines)
+    assert rc == 1
+    assert "stage decode" in text and "beyond" in text
+    assert "new failure in candidate: bad.mp4" in text
+    # identity compare stays green
+    rc, _ = compare_runs.compare(str(a), str(a))
+    assert rc == 0
+
+
+def test_compare_runs_detects_truncated_artifact(tmp_path):
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import compare_runs
+    finally:
+        sys.path.pop(0)
+    from video_features_tpu.telemetry.spans import VideoSpan
+
+    def run_dir(d, nbytes):
+        d.mkdir()
+        with VideoSpan("v.mp4", feature_type="resnet") as span:
+            span.annotate(status="done")
+            span.event("artifact", key="feat", file="v_feat.npy",
+                       bytes=nbytes, sha256=f"sha-{nbytes}")
+        tjsonl.append_jsonl(d / "_telemetry.jsonl", span.record)
+    run_dir(tmp_path / "a", 4096)
+    run_dir(tmp_path / "b", 128)
+    rc, lines = compare_runs.compare(str(tmp_path / "a"),
+                                     str(tmp_path / "b"))
+    assert rc == 1
+    assert any("artifact shrank" in x for x in lines)
+
+
+# -- bench history ----------------------------------------------------------
+
+def test_bench_history_append_idempotent_and_regression(tmp_path):
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import bench_history
+    finally:
+        sys.path.pop(0)
+    hist = str(tmp_path / "BENCH_history.jsonl")
+    r1 = tmp_path / "r1.json"
+    r2 = tmp_path / "r2.json"
+    r1.write_text(json.dumps({"n": 1, "parsed": {
+        "metric": "m throughput", "value": 100.0, "unit": "clips/sec",
+        "metrics": [{"metric": "overhead", "value": 1.0,
+                     "unit": "x wall-clock"}]}}))
+    r2.write_text(json.dumps({"n": 2, "parsed": {
+        "metric": "m throughput", "value": 50.0, "unit": "clips/sec",
+        "metrics": [{"metric": "overhead", "value": 1.5,
+                     "unit": "x wall-clock"}]}}))
+    assert bench_history.append_rounds(hist, [str(r1), str(r2)]) == 0
+    assert len(bench_history.load_history(hist)) == 2
+    bench_history.append_rounds(hist, [str(r1)])  # idempotent
+    assert len(bench_history.load_history(hist)) == 2
+    regressions, lines = bench_history.check_regressions(hist, band=0.2)
+    text = "\n".join(lines)
+    # throughput halved (down = bad) AND overhead grew (up = bad)
+    assert len(regressions) == 2, text
+    # CLI: --fail-on-regression turns the flag into exit 1
+    p = subprocess.run(
+        [sys.executable, str(SCRIPTS / "bench_history.py"), "check",
+         "--history", hist, "--fail-on-regression"],
+        capture_output=True, text=True)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "REGRESSION" in p.stdout
+
+
+def test_bench_history_raw_line_and_stdin_roundtrip(tmp_path):
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import bench_history
+    finally:
+        sys.path.pop(0)
+    hist = str(tmp_path / "h.jsonl")
+    raw = tmp_path / "line.json"
+    raw.write_text(json.dumps({"metric": "x", "value": 5, "unit": "u"}))
+    bench_history.append_rounds(hist, [str(raw)])
+    recs = bench_history.load_history(hist)
+    assert recs[0]["round"] == 1  # inferred when the line carries no n
+    assert recs[0]["headline"]["value"] == 5
+
+
+# -- telemetry_report --fail-on-failures ------------------------------------
+
+def test_report_fail_on_failures_gate(tmp_path):
+    out = tmp_path / "out"
+    out.mkdir()
+    report = [sys.executable, str(SCRIPTS / "telemetry_report.py"),
+              str(out), "--fail-on-failures"]
+    p = subprocess.run(report, capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr  # empty journal: green
+    tjsonl.append_jsonl(out / "_failures.jsonl", {
+        "video": "bad.mp4", "category": "POISON", "attempts": 3,
+        "error": "x"})
+    p = subprocess.run(report, capture_output=True, text=True)
+    assert p.returncode == 1
+    # a RESOLVED record lifts the gate (journal last-record-wins contract)
+    tjsonl.append_jsonl(out / "_failures.jsonl", {
+        "video": "bad.mp4", "category": "RESOLVED"})
+    p = subprocess.run(report, capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# -- run_id heartbeat hygiene ----------------------------------------------
+
+def test_stale_heartbeats_from_prior_run_are_ignored(tmp_path):
+    from video_features_tpu.telemetry.heartbeat import matches_run
+    # same id, missing ids -> keep; different id + older than the run ->
+    # stale; different id but still ticking (fleet sibling) -> keep
+    assert matches_run({"run_id": "a", "time": 1.0}, "a", 100.0)
+    assert matches_run({"time": 1.0}, "a", 100.0)
+    assert matches_run({"run_id": "b", "time": 1.0}, None, None)
+    assert not matches_run({"run_id": "b", "time": 1.0}, "a", 100.0)
+    assert matches_run({"run_id": "b", "time": 150.0}, "a", 100.0)
+
+    out = tmp_path / "out"
+    out.mkdir()
+    tjsonl.write_json_atomic(out / "_run.json", {
+        "schema": "vft.run_manifest/1", "run_id": "current",
+        "started_time": 1000.0, "tally": {}})
+    tjsonl.write_json_atomic(out / "_heartbeat_old-host.json", {
+        "schema": "vft.heartbeat/1", "run_id": "previous",
+        "host_id": "old-host", "time": 10.0, "interval_s": 30})
+    tjsonl.write_json_atomic(out / "_heartbeat_new-host.json", {
+        "schema": "vft.heartbeat/1", "run_id": "current",
+        "host_id": "new-host", "time": 2000.0, "interval_s": 30,
+        "final": True, "videos_done": 1})
+    p = subprocess.run(
+        [sys.executable, str(SCRIPTS / "telemetry_report.py"), str(out)],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PRIOR RUN" in p.stdout and "old-host" in p.stdout
+    assert "FINISHED" in p.stdout  # the current run's heartbeat renders
+
+
+# -- recorder roll-up -------------------------------------------------------
+
+def test_recorder_health_rollup_lands_in_manifest(tmp_path):
+    from video_features_tpu.telemetry.recorder import TelemetryRecorder
+    out = str(tmp_path / "out")
+    rec = TelemetryRecorder(out, feature_type="resnet", interval_s=60.0,
+                            host_id="p0-test").start()
+    try:
+        good = np.ones(8, dtype=np.float32)
+        bad = good.copy()
+        bad[0] = np.nan
+        health.digest_features({"feat": good}, "a.mp4", "resnet", out)
+        health.digest_features({"feat": bad}, "b.mp4", "raft", out)
+    finally:
+        rec.close(tally={"done": 2})
+    man = json.load(open(os.path.join(out, "_run.json")))
+    assert man["run_id"] == rec.run_id
+    assert man["health"]["resnet"] == {
+        "records": 1, "nonfinite_records": 0, "nan": 0, "inf": 0}
+    assert man["health"]["raft"]["nan"] == 1
+    assert man["health"]["raft"]["nonfinite_records"] == 1
+    # the nonfinite counter series landed in the metrics dump
+    names = {s["name"] for s in man["metrics"]["series"]}
+    assert "vft_health_nonfinite_total" in names
+    hb = json.load(open(os.path.join(
+        out, "_heartbeat_p0-test.json")))
+    assert hb["run_id"] == rec.run_id

@@ -1,0 +1,128 @@
+"""The three scripts/check_*_schema.py CI gates must report torn,
+truncated or empty artifact files as findings — never die with a
+traceback (a gate that crashes reads as infra flake and gets retried
+instead of investigated). Before this suite only trace_report.py's
+error path was pinned (tests/test_trace.py)."""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SCRIPTS = REPO_ROOT / "scripts"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(name,
+                                                  SCRIPTS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- trace gate -------------------------------------------------------------
+
+def test_trace_gate_reports_missing_empty_and_torn_trace(tmp_path):
+    gate = _load_script("check_trace_schema")
+
+    missing = tmp_path / "missing"
+    missing.mkdir()
+    errs = gate.check(missing)
+    assert errs and "was not written" in errs[0]
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "_trace.json").write_text("")
+    errs = gate.check(empty)
+    assert errs and "not valid JSON" in errs[0]
+
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    doc = json.dumps({"traceEvents": [{"ph": "X", "name": "decode",
+                                       "ts": 0, "dur": 1, "pid": 1,
+                                       "tid": 1}]})
+    (torn / "_trace.json").write_text(doc[: len(doc) // 2])
+    errs = gate.check(torn)
+    assert errs and "not valid JSON" in errs[0]
+
+    hollow = tmp_path / "hollow"
+    hollow.mkdir()
+    (hollow / "_trace.json").write_text(json.dumps({"traceEvents": []}))
+    errs = gate.check(hollow)
+    assert errs and "no traceEvents" in errs[0]
+
+
+def test_trace_gate_reports_torn_heartbeat_not_traceback(tmp_path):
+    gate = _load_script("check_trace_schema")
+    out = tmp_path / "out"
+    out.mkdir()
+    # minimal structurally-valid trace so the check reaches the heartbeat
+    (out / "_trace.json").write_text(json.dumps({
+        "otherData": {"schema": gate.TRACE_SCHEMA},
+        "traceEvents": [{"ph": "X", "name": "decode", "ts": 0, "dur": 1,
+                         "pid": 1, "tid": 1, "args": {}}]}))
+    (out / "_heartbeat_host.json").write_text('{"fanout": {"queue_')
+    errs = gate.check(out)  # must return findings, not raise
+    assert any("write_json_atomic contract broke" in e for e in errs)
+
+
+# -- telemetry gate ---------------------------------------------------------
+
+def test_telemetry_gate_reports_torn_schema_file(tmp_path, monkeypatch):
+    gate = _load_script("check_telemetry_schema")
+    from video_features_tpu.telemetry import schema as tschema
+    good = Path(tschema.SPAN_SCHEMA_PATH).read_text()
+
+    for label, payload in (("empty", ""), ("torn", good[: len(good) // 2])):
+        broken = tmp_path / f"{label}.schema.json"
+        broken.write_text(payload)
+        monkeypatch.setattr(tschema, "SPAN_SCHEMA_PATH", str(broken))
+        errs = gate.check()
+        assert errs and "cannot load" in errs[0], (label, errs)
+
+    monkeypatch.setattr(tschema, "SPAN_SCHEMA_PATH",
+                        str(tmp_path / "absent.schema.json"))
+    errs = gate.check()
+    assert errs and "cannot load" in errs[0]
+
+
+# -- health gate ------------------------------------------------------------
+
+def test_health_gate_reports_torn_schema_file(tmp_path, monkeypatch):
+    gate = _load_script("check_health_schema")
+    from video_features_tpu.telemetry import health
+    good = Path(health.HEALTH_SCHEMA_PATH).read_text()
+    for label, payload in (("empty", ""), ("torn", good[: len(good) // 2])):
+        broken = tmp_path / f"{label}.schema.json"
+        broken.write_text(payload)
+        monkeypatch.setattr(health, "HEALTH_SCHEMA_PATH", str(broken))
+        errs = gate.check_static()
+        assert errs and "cannot load" in errs[0], (label, errs)
+
+
+def test_health_jsonl_torn_tail_skipped_not_fatal(tmp_path):
+    # the artifact reader every consumer (gate, compare_runs) shares:
+    # one good record + a SIGKILL-torn tail -> the good record survives
+    import numpy as np
+    from video_features_tpu.telemetry import health
+    from video_features_tpu.telemetry.jsonl import read_jsonl
+    health.digest_features({"feat": np.ones(4, dtype=np.float32)},
+                           "v.mp4", "resnet", str(tmp_path))
+    path = tmp_path / health.HEALTH_FILENAME
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"schema": "vft.feature_health/1", "video": "torn')
+    recs = list(read_jsonl(path))
+    assert len(recs) == 1
+    assert health.validate_health(recs[0]) == []
+    # and compare_runs' loader sees exactly the surviving record
+    sys.path.insert(0, str(SCRIPTS))
+    try:
+        import compare_runs
+    finally:
+        sys.path.pop(0)
+    assert len(compare_runs.load_health(str(tmp_path))) == 1

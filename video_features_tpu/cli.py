@@ -232,6 +232,16 @@ def main(argv: Optional[List[str]] = None) -> None:
         from .telemetry.trace import TraceRecorder
         tracer = TraceRecorder(out_root).start()
 
+    # Output health (health=true): per-(video, family) feature digests at
+    # the sink boundary, appended to each family's {output_path}/
+    # _health.jsonl, with NaN/Inf outputs quarantined via the faults
+    # taxonomy instead of written (telemetry/health.py). The gate itself
+    # lives in BaseExtractor.action_on_extraction — this flag only drives
+    # the end-of-run pointer below.
+    health_on = (any(bool(a.get("health", False))
+                     for a in per_family.values())
+                 if multi_mode else bool(args.get("health", False)))
+
     def run_one(video_path: str) -> None:
         if stop.is_set():
             return
@@ -369,6 +379,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"trace: {tracer.trace_path} (render with "
               f"scripts/trace_report.py {out_root}, or open in "
               "https://ui.perfetto.dev)")
+    if health_on:
+        from .telemetry.health import HEALTH_FILENAME
+        print(f"health: per-(video, family) feature digests in "
+              f"{{output_path}}/{HEALTH_FILENAME} under {out_root} "
+              f"(diff two runs with scripts/compare_runs.py)")
     if profiler.enabled:
         print(profiler.summary(f"profile: {run_label} x "
                                f"{len(video_paths)} videos"))

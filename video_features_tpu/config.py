@@ -321,6 +321,11 @@ def sanity_check(args: Config) -> None:
     if not isinstance(tr, bool):
         raise ValueError(f"trace={tr!r}: expected true or false (writes "
                          "{output_path}/_trace.json, telemetry/trace.py)")
+    he = args.get("health", False)
+    if not isinstance(he, bool):
+        raise ValueError(f"health={he!r}: expected true or false (digests "
+                         "features into {output_path}/_health.jsonl and "
+                         "quarantines NaN/Inf outputs, telemetry/health.py)")
 
     fps_mode = args.get("fps_mode", "select") or "select"
     if fps_mode not in ("select", "reencode"):

@@ -36,6 +36,11 @@ class BaseExtractor:
             # 'bfloat16' mode keeps the MXU-native fast path instead
             jax.config.update("jax_default_matmul_precision", "highest")
         self.show_pred = bool(args.get("show_pred", False))
+        # health=true (telemetry/health.py): digest every feature tensor
+        # at the sink boundary into {output_path}/_health.jsonl and refuse
+        # to write NaN/Inf (routed through the faults taxonomy as POISON).
+        # Off by default; the disabled cost is this one attribute read.
+        self.health = bool(args.get("health", False))
         # video_decode=process: each video's decode+transform runs in a
         # spawned worker process (utils/io.py ProcessVideoSource) — lifts
         # the parent-GIL ceiling on numpy/PIL transform work on multi-core
@@ -204,6 +209,16 @@ class BaseExtractor:
 
     def action_on_extraction(self, feats: Dict[str, np.ndarray],
                              video_path: str) -> None:
+        if self.health:
+            # digest + gate BEFORE any sink write: a non-finite feature
+            # raises (POISON) so it journals/quarantines instead of being
+            # silently persisted; the digest record of the bad tensor is
+            # already in _health.jsonl for the post-mortem
+            from ..telemetry import health
+            from ..utils.profiling import profiler
+            with profiler.stage("health"):
+                health.check_features(feats, video_path, self.feature_type,
+                                      self.output_path)
         # re-check before overwrite: another worker may have just written it
         # (reference base_extractor.py:72-76)
         if self.on_extraction != "print" and sinks.is_already_exist(

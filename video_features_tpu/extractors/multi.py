@@ -9,8 +9,10 @@ order-of-magnitude-class end-to-end win on decode-bound hosts
 Composition, not reimplementation: each family keeps its OWN extractor
 instance, config (per-family dotted overrides like
 ``clip.extraction_fps=2``), output directory + idempotent skip, retry
-policy, failure journal, and telemetry span — the MultiExtractor only
-coordinates. Per video:
+policy, failure journal, telemetry span, and output-health gate
+(``health=true`` digests into the family's own ``_health.jsonl``, and a
+family whose features go non-finite quarantines alone —
+telemetry/health.py) — the MultiExtractor only coordinates. Per video:
 
   1. **Skip sweep** — families whose outputs already exist are tallied
      ``skipped`` up front; when EVERY family skips, no decoder (or wav

@@ -12,6 +12,9 @@ last night's run actually do" from *artifacts*, not a live terminal:
   ``_run.json``                run manifest at exit (telemetry/manifest.py)
   ``_heartbeat_{host_id}.json``  periodic per-worker liveness
                                (telemetry/heartbeat.py)
+  ``_health.jsonl``            per-(video, family, key) feature digests
+                               (telemetry/health.py, ``health=true``;
+                               schema in ``feature_health.schema.json``)
   metrics registry             counters/gauges/fixed-bucket histograms
                                (telemetry/metrics.py), dumped into the
                                manifest + Prometheus export via

@@ -34,6 +34,33 @@ def heartbeat_filename(host_id: str) -> str:
     return f"{HEARTBEAT_PREFIX}{safe}.json"
 
 
+def matches_run(heartbeat: dict, run_id: Optional[str],
+                started_time: Optional[float] = None) -> bool:
+    """False iff this heartbeat demonstrably belongs to a PRIOR run than
+    ``run_id`` (the manifest's). Output dirs are reused across runs and
+    a worker that died without a final heartbeat leaves its file behind;
+    report tools must not count that stale file as a live (or stalled)
+    worker of the current run.
+
+    Each host mints its own run_id (hosts never talk, they only co-own
+    the output dir), so a multi-host fleet legitimately shows N distinct
+    run_ids — a mismatched id only marks staleness when the heartbeat
+    also PREDATES the manifest's ``started_time`` (a fleet sibling keeps
+    refreshing its file, so its timestamp stays current). Either side
+    missing a run_id (pre-run_id artifacts) matches: an unprovable
+    mismatch stays visible rather than silently dropped."""
+    hb_run = heartbeat.get("run_id")
+    if run_id is None or hb_run is None or str(hb_run) == str(run_id):
+        return True
+    if started_time is None:
+        return False
+    hb_time = heartbeat.get("time")
+    try:
+        return hb_time is not None and float(hb_time) >= float(started_time)
+    except (TypeError, ValueError):
+        return False
+
+
 class HeartbeatThread:
     """Fires ``tick()`` every ``interval_s`` until :meth:`stop`.
 

@@ -69,6 +69,7 @@ def build_manifest(*,
                    run_config: Optional[dict] = None,
                    feature_type: Optional[str] = None,
                    host_id: Optional[str] = None,
+                   run_id: Optional[str] = None,
                    started_time: Optional[float] = None,
                    wall_s: Optional[float] = None,
                    tally: Optional[Dict[str, int]] = None,
@@ -76,6 +77,7 @@ def build_manifest(*,
                    stage_totals: Optional[Dict[str, Any]] = None,
                    metrics_dump: Optional[dict] = None,
                    compile_cache: Optional[Dict[str, int]] = None,
+                   health: Optional[Dict[str, Dict[str, int]]] = None,
                    ) -> dict:
     done = (tally or {}).get("done", 0)
     return {
@@ -83,6 +85,9 @@ def build_manifest(*,
         "feature_type": feature_type,
         "host": socket.gethostname(),
         "host_id": host_id,
+        # matches the run_id in this run's heartbeats; report tools use it
+        # to ignore stale heartbeat files from a prior run of the same dir
+        "run_id": run_id,
         "pid": os.getpid(),
         "argv": list(sys.argv),
         "started_time": started_time,
@@ -94,6 +99,9 @@ def build_manifest(*,
         "failure_tallies": dict(failure_tallies or {}),
         "stage_totals": dict(stage_totals or {}),
         "compile_cache": dict(compile_cache or {}),
+        # output-health roll-up (telemetry/health.py): per-family digest
+        # record + NaN/Inf totals; {} when health=false (nothing observed)
+        "health": dict(health or {}),
         "config": dict(run_config or {}),
         "versions": _versions(),
         "git": _git_describe(),

@@ -28,6 +28,7 @@ import os
 import socket
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional
 
 from ..utils.profiling import StageProfiler, profiler
@@ -110,6 +111,11 @@ class TelemetryRecorder:
         self.feature_type = feature_type
         self.interval_s = float(interval_s)
         self.host_id = host_id or socket.gethostname()
+        # run identity: stamped into the manifest AND every heartbeat so
+        # report tools can tell THIS run's heartbeats from stale files a
+        # prior run left in the same output_path (telemetry_report.py
+        # marks + excludes other-run heartbeats instead of summing them)
+        self.run_id = uuid.uuid4().hex[:12]
         self.registry = MetricsRegistry()
         self.spans_path = os.path.join(self.output_path, SPANS_FILENAME)
         self.heartbeat_path = os.path.join(
@@ -124,6 +130,9 @@ class TelemetryRecorder:
         self._state_lock = threading.Lock()
         self._last_video: Optional[str] = None
         self._status_counts: Dict[str, int] = {}
+        # output-health roll-up (telemetry/health.py digest_features feeds
+        # it): per-family record / NaN / Inf totals for the manifest
+        self._health: Dict[str, Dict[str, int]] = {}
         self._t0 = time.perf_counter()
         self._start_time = time.time()
         self._mon_baseline: Dict[str, int] = {}
@@ -189,6 +198,25 @@ class TelemetryRecorder:
             self._status_counts[status] = \
                 self._status_counts.get(status, 0) + 1
 
+    # -- output health (telemetry/health.py) ---------------------------------
+    def health_observe(self, rec: dict) -> None:
+        """Fold one feature digest into the per-family manifest roll-up."""
+        fam = str(rec.get("feature_type") or "?")
+        nonfinite = int(rec.get("nan", 0)) + int(rec.get("inf", 0))
+        with self._state_lock:
+            h = self._health.setdefault(
+                fam, {"records": 0, "nonfinite_records": 0,
+                      "nan": 0, "inf": 0})
+            h["records"] += 1
+            h["nan"] += int(rec.get("nan", 0))
+            h["inf"] += int(rec.get("inf", 0))
+            if nonfinite:
+                h["nonfinite_records"] += 1
+
+    def health_summary(self) -> Dict[str, Dict[str, int]]:
+        with self._state_lock:
+            return {f: dict(v) for f, v in self._health.items()}
+
     # -- stage hook (installed on the global profiler) -----------------------
     def _observe_stage(self, name: str, dt: float) -> None:
         self.registry.histogram("vft_stage_seconds", buckets=LATENCY_BUCKETS,
@@ -219,6 +247,7 @@ class TelemetryRecorder:
                  for k, v in self._delta_stages.drain().items()}
         return {
             "schema": "vft.heartbeat/1",
+            "run_id": self.run_id,
             "host": socket.gethostname(),
             "host_id": self.host_id,
             "pid": os.getpid(),
@@ -277,6 +306,8 @@ class TelemetryRecorder:
             run_config=self.run_config,
             feature_type=self.feature_type,
             host_id=self.host_id,
+            run_id=self.run_id,
+            health=self.health_summary(),
             started_time=round(self._start_time, 3),
             wall_s=wall_s if wall_s is not None
             else time.perf_counter() - self._t0,

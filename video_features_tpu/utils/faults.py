@@ -74,8 +74,8 @@ class FatalError(Exception):
 #: substrings of worker-forwarded error strings (the decode subprocess
 #: protocol ships ``f"{type(e).__name__}: {e}"``, utils/io.py) that mark
 #: the CHILD's exception as input-shaped
-_POISON_MARKERS = ("ValueError", "PoisonError", "No decodable frames",
-                   "Cannot determine fps")
+_POISON_MARKERS = ("ValueError", "PoisonError", "NonFiniteFeatureError",
+                   "No decodable frames", "Cannot determine fps")
 
 
 def classify(exc: BaseException) -> str:
@@ -90,6 +90,12 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, FatalError):
         return FATAL
     if isinstance(exc, PoisonError):
+        return POISON
+    from ..telemetry.health import NonFiniteFeatureError
+    if isinstance(exc, NonFiniteFeatureError):
+        # the output-health gate (telemetry/health.py, health=true) found
+        # NaN/Inf in a computed feature: quarantine over silent write —
+        # retries rarely fix a numerically-poisoned (input, model) pair
         return POISON
     if isinstance(exc, (NotImplementedError, AssertionError, TypeError,
                         AttributeError, NameError, ImportError)):

@@ -17,11 +17,14 @@ mechanism.
 """
 from __future__ import annotations
 
+import hashlib
+import io
 import os
 import pickle
+import tempfile
 import traceback
 from pathlib import Path
-from typing import Dict, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,25 +41,10 @@ def load_numpy(fpath):
     return np.load(fpath)
 
 
-def write_numpy(fpath, value):
-    from .. import native
-    # temp-file + fsync + atomic rename (native/vft_native.cpp): a preempted
-    # worker can never leave a half-written feature file behind
-    if native.write_npy_atomic(fpath, value):
-        return
-    return np.save(fpath, value)
-
-
-def load_pickle(fpath):
-    with open(fpath, "rb") as f:
-        return pickle.load(f)
-
-
-def write_pickle(fpath, value):
-    # same temp-file + fsync + atomic-rename discipline as write_numpy: a
-    # preempted worker must never leave a torn .pkl that load_pickle would
-    # half-read (or that poisons is_already_exist's resume check forever)
-    import tempfile
+def _write_bytes_atomic(fpath, data: bytes) -> None:
+    """Temp file in the target dir + flush + fsync + ``os.replace`` — the
+    same contract as native write_npy_atomic, for already-serialized
+    bytes (the hash-before-rename artifact-digest path)."""
     d = os.path.dirname(fpath) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d,
@@ -64,7 +52,7 @@ def write_pickle(fpath, value):
                                suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(value, f)
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, fpath)
@@ -74,6 +62,44 @@ def write_pickle(fpath, value):
         except OSError:
             pass
         raise
+
+
+def write_numpy(fpath, value, want_digest: bool = False
+                ) -> Optional[Tuple[int, str]]:
+    """Atomic .npy write; with ``want_digest`` returns ``(bytes, sha256)``
+    of EXACTLY what was renamed into place (serialized once in memory,
+    hashed before the rename — so the digest can never describe a file a
+    concurrent worker replaced underneath us)."""
+    from .. import native
+    if want_digest:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(value))
+        data = buf.getvalue()
+        _write_bytes_atomic(fpath, data)
+        return len(data), hashlib.sha256(data).hexdigest()
+    # temp-file + fsync + atomic rename (native/vft_native.cpp): a preempted
+    # worker can never leave a half-written feature file behind
+    if native.write_npy_atomic(fpath, value):
+        return None
+    np.save(fpath, value)
+    return None
+
+
+def load_pickle(fpath):
+    with open(fpath, "rb") as f:
+        return pickle.load(f)
+
+
+def write_pickle(fpath, value, want_digest: bool = False
+                 ) -> Optional[Tuple[int, str]]:
+    # same temp-file + fsync + atomic-rename discipline as write_numpy: a
+    # preempted worker must never leave a torn .pkl that load_pickle would
+    # half-read (or that poisons is_already_exist's resume check forever)
+    data = pickle.dumps(value)
+    _write_bytes_atomic(fpath, data)
+    if want_digest:
+        return len(data), hashlib.sha256(data).hexdigest()
+    return None
 
 
 def is_already_exist(on_extraction: str, output_path: str, video_path: str,
@@ -143,16 +169,25 @@ def action_on_extraction(feats_dict: Dict[str, np.ndarray],
         raise NotImplementedError(f"on_extraction: {on_extraction}")
 
     from .profiling import profiler
+    from .. import telemetry
 
     os.makedirs(output_path, exist_ok=True)
     writer = write_numpy if on_extraction == "save_numpy" else write_pickle
+    # with a live span, each write also records what landed on disk
+    # (byte size + sha256 of the renamed bytes) as an `artifact` span
+    # event, so scripts/compare_runs.py can detect truncated or changed
+    # outputs between runs without re-reading any feature file
+    span = telemetry.current_span()
     for key, value in feats_dict.items():
         fpath = make_path(output_path, video_path, key, EXTS[on_extraction])
         arr = np.asarray(value)
         if arr.size == 0:
             print("Warning: the value is empty for", key, "@", video_path)
         with profiler.stage("write"):
-            writer(fpath, value)
+            info = writer(fpath, value, want_digest=span is not None)
+        if info is not None:
+            span.event("artifact", key=key, file=os.path.basename(fpath),
+                       bytes=info[0], sha256=info[1])
 
 
 def safe_extract(extract_fn, video_path: str, policy=None, journal=None,

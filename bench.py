@@ -316,12 +316,17 @@ def bench_pipeline(n_copies: int = 8) -> dict:
     """Sustained REAL-pipeline throughput: decode -> transform -> device ->
     sink, through the actual CLI driver, on ``n_copies`` of the vendored
     sample video — the deliverable number next to the device-only steady
-    state (which assumes decode keeps up). Uses the headline device config
-    (yuv420 ingest, bf16, clip_batch_size=128) with cross-video batching,
-    so short videos can actually fill the B=128 groups the device number
-    is measured at. On a few-core host this is decode-bound — that gap IS
-    the measurement."""
+    state (which assumes decode keeps up). Uses the RECORDED production
+    configuration: yuv420 ingest, bf16, ClipPacker cross-video batching at
+    the B=128 sweet spot, video_workers=auto. Runs with ``trace=true`` and
+    publishes the per-stage decode/transform/h2d/device/write breakdown +
+    X-bound verdict from the trace (scripts/trace_report.py stage_summary),
+    so every round's sustained number carries its own roofline diagnosis —
+    on a few-core host this is decode-bound, and the stage split proves by
+    how much (docs/performance.md 'The host roofline, demolished by
+    stages')."""
     import shutil
+    import sys as _sys
     import tempfile
     from pathlib import Path
 
@@ -331,7 +336,6 @@ def bench_pipeline(n_copies: int = 8) -> dict:
     if not sample.exists():
         raise FileNotFoundError("no sample video for the pipeline bench")
     import contextlib
-    import sys as _sys
     from video_features_tpu.cli import main as cli_main
     with tempfile.TemporaryDirectory(prefix="vft_bench_pipe_") as td:
         vids = []
@@ -347,6 +351,7 @@ def bench_pipeline(n_copies: int = 8) -> dict:
                 "feature_type=r21d", "precision=bfloat16", "ingest=yuv420",
                 "clip_batch_size=128", "cross_video_batching=true",
                 "video_workers=auto", "allow_random_weights=true",
+                "trace=true",
                 "on_extraction=save_numpy", f"output_path={td}/out",
                 f"tmp_path={td}/tmp",
                 "video_paths=[" + ",".join(vids) + "]",
@@ -354,6 +359,17 @@ def bench_pipeline(n_copies: int = 8) -> dict:
         wall = time.perf_counter() - t0
         outputs = list(Path(td, "out").rglob("*_r21d.npy"))
         clips = sum(np.load(p).shape[0] for p in outputs)
+        stages = None
+        try:
+            sys.path.insert(0, str(Path(__file__).parent / "scripts"))
+            import trace_report
+            traces = sorted(Path(td, "out").rglob(
+                trace_report.TRACE_FILENAME))
+            if traces:
+                stages = trace_report.stage_summary(str(traces[0].parent))
+        except BaseException as e:  # breakdown is telemetry, not the metric
+            print(f"WARNING: pipeline stage breakdown failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
     if len(outputs) < n_copies:
         # cli_main tallies per-video failures and returns normally; a bench
         # over identical healthy copies must complete ALL of them — anything
@@ -362,8 +378,11 @@ def bench_pipeline(n_copies: int = 8) -> dict:
         raise RuntimeError(
             f"pipeline bench: only {len(outputs)}/{n_copies} videos "
             "produced features — failed runs must not publish throughput")
-    return {"videos_per_s": n_copies / wall, "clips_per_s": clips / wall,
-            "clips": clips, "wall_s": wall}
+    result = {"videos_per_s": n_copies / wall, "clips_per_s": clips / wall,
+              "clips": clips, "wall_s": wall}
+    if stages:
+        result["stages"] = stages
+    return result
 
 
 def bench_shared_decode(families=("resnet", "clip", "s3d"),
@@ -973,7 +992,7 @@ def main() -> None:
     # is cold, so cache warmth (the two device benches above) matters
     try:
         pipe = bench_pipeline()
-        metrics.append({
+        row = {
             "metric": "r2plus1d_18 sustained pipeline decode->device->sink",
             "value": round(pipe["clips_per_s"], 2),
             "unit": "clips/sec",
@@ -981,8 +1000,14 @@ def main() -> None:
             # a real field, not prose in the metric name, so the compact
             # line's truncation can never drop it
             "videos_per_s": round(pipe["videos_per_s"], 2),
-            "note": "8x sample video, yuv420+bf16, cross-video B=128",
-        })
+            "note": "8x sample video, yuv420+bf16, cross-video B=128, "
+                    "video_workers=auto (the recorded configuration)",
+        }
+        if pipe.get("stages"):
+            # the roofline attribution rides the row: per-stage ms +
+            # X-bound verdict from the run's own trace
+            row["stages"] = pipe["stages"]
+        metrics.append(row)
     except Exception as e:
         print(f"WARNING: pipeline bench failed: {type(e).__name__}: {e}",
               file=__import__("sys").stderr)

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Defaults-flip gate: the resize=auto (device-by-default) save path must
+keep health digests inside the established drift bands.
+
+PR 6 flipped ``resize`` from ``host`` to ``auto`` (device resize for
+save runs). The device resize is PIL within 2 LSB by construction
+(tests/test_io.py), but this gate pins the user-visible consequence at
+the artifact layer: one real resnet save run under the OLD default
+(``resize=host``) and one under the NEW default (no resize key ->
+``auto`` -> device), both with ``health=true``, compared by
+``scripts/compare_runs.py`` under its stock atol=1e-2 bands — the same
+quantization-tolerant digest discipline PR 5 established. A PASS means
+the flip cannot have moved any feature beyond the tolerance the value
+tier already grants; shape/dtype/NaN changes are hard failures.
+
+Also asserts the new default run still emits schema-valid health + trace
+artifacts (the check_*_schema gates run the same defaults elsewhere in
+the quick job — this script pins the A/B).
+
+Exit 0 = flip is digest-stable; exit 1 = drift itemized by compare_runs.
+Runs on CPU in the quick CI tier (~a minute: random weights, tiny frame
+budget).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+
+def run(out: Path, tmp: Path, *extra: str) -> None:
+    from video_features_tpu.cli import main as cli_main
+    with contextlib.redirect_stdout(sys.stderr):
+        cli_main([
+            "feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "batch_size=8", "extraction_total=6",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            "retry_attempts=1", "health=true", "telemetry=true",
+            "metrics_interval_s=60",
+            f"output_path={out}", f"tmp_path={tmp}",
+            f"video_paths={SAMPLE}", *extra,
+        ])
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"defaults-flip gate SKIP: vendored sample missing at "
+              f"{SAMPLE}")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_flip_gate_") as td:
+        old = Path(td) / "old"
+        new = Path(td) / "new"
+        tmp = Path(td) / "tmp"
+        run(old, tmp, "resize=host")   # the pre-flip default
+        run(new, tmp)                  # stock config: resize=auto -> device
+        p = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts" / "compare_runs.py"),
+             str(old), str(new)], capture_output=True, text=True)
+        sys.stderr.write(p.stdout[-2000:] + p.stderr[-1000:])
+        if p.returncode != 0:
+            print("defaults-flip gate FAIL: resize=auto run drifted beyond "
+                  "the atol=1e-2 health-digest bands vs resize=host "
+                  "(compare_runs output above)")
+            return 1
+    print("defaults-flip gate OK: resize=auto (device) save run is "
+          "digest-stable vs the old resize=host default under the stock "
+          "compare_runs bands")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,9 +27,14 @@ Usage (any main.py key=value passes through):
     python scripts/throughput.py --families resnet,clip,s3d --rounds 3 \
         device=cpu extraction_fps=4 allow_random_weights=true
 
+    # roofline in one command: --stages re-runs each knob set with
+    # trace=true and appends the per-stage decode/transform/h2d/device/
+    # write ms + X-bound verdict from the trace artifact to each line
+    python scripts/throughput.py feature_type=resnet --repeat 4 --stages
+
 Prints one JSON line per knob set:
     {"config": ..., "videos": N, "seconds": S, "videos_per_s": ...,
-     "frames_per_s": ...}
+     "frames_per_s": ..., "stages": {...}?}
 
 Each config gets an UNTIMED single-video warmup pass before its timed run
 (weight load, page cache, jit compiles), so ordering does not bias the
@@ -48,6 +53,27 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def _stage_summary(outdir: Path) -> dict:
+    """Per-stage decode/transform/h2d/device/write totals + verdict from
+    the run's ``_trace.json`` (scripts/trace_report.py stage_summary) —
+    the --stages payload that makes roofline claims reproducible from one
+    command."""
+    import trace_report
+
+    # the recorder writes at the run's output ROOT — for single-family
+    # runs that is the family-namespaced subdir sanity_check appended
+    target = outdir
+    if not (outdir / trace_report.TRACE_FILENAME).exists():
+        found = sorted(outdir.rglob(trace_report.TRACE_FILENAME))
+        if found:
+            target = found[0].parent
+    try:
+        return trace_report.stage_summary(str(target))
+    except SystemExit as e:  # missing/torn trace: report, don't crash
+        return {"error": str(e)}
 
 SAMPLE = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
 if not SAMPLE.exists():  # hosts without the reference mount: the
@@ -56,8 +82,13 @@ if not SAMPLE.exists():  # hosts without the reference mount: the
               / "v_synth_sample.mp4")
 
 
-def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
+def run_config(base_args, videos, workdir: Path, tag: str,
+               stages: bool = False) -> dict:
     from video_features_tpu.cli import main as cli_main
+    if stages:
+        # trace=true so the per-stage breakdown below comes from the same
+        # timed pass being reported (PR 4 trace; ~<=1.05x overhead budget)
+        base_args = list(base_args) + ["trace=true"]
     out = workdir / f"out_{tag}"
     # untimed warmup: one video into a throwaway dir, so this config pays its
     # own weight-loading/page-cache/compile costs before the clock starts
@@ -92,6 +123,8 @@ def run_config(base_args, videos, workdir: Path, tag: str) -> dict:
         if feat_files:
             clips = int(sum(np.load(f).shape[0] for f in feat_files))
             result["clips_per_s"] = round(clips / dt, 2)
+    if stages:
+        result["stages"] = _stage_summary(out)
     return result
 
 
@@ -134,7 +167,7 @@ def _single_family_args(base, fam, families):
 
 
 def run_families_ab(families, base, videos, workdir: Path,
-                    rounds: int) -> dict:
+                    rounds: int, stages: bool = False) -> dict:
     """Interleaved A/B: per round, time the N single-family runs back to
     back (sequential baseline — N decode passes) THEN the one
     shared-decode multi-family run, each into fresh output dirs so the
@@ -144,6 +177,8 @@ def run_families_ab(families, base, videos, workdir: Path,
     are compared bit-for-bit (single vs shared must be identical)."""
     import statistics
     base = [a for a in base if not a.startswith("feature_type=")]
+    if stages:
+        base = base + ["trace=true"]
     tmpdir = workdir / "tmp"
     # untimed warmup per variant: weight load, page cache, jit compiles
     for fam in families:
@@ -174,7 +209,7 @@ def run_families_ab(families, base, videos, workdir: Path,
             shutil.copy(p, seq_out / rel)
     med_seq = statistics.median(seq_s)
     med_shared = statistics.median(shared_s)
-    return {
+    result = {
         "families": list(families),
         "videos": len(videos),
         "rounds": rounds,
@@ -185,6 +220,15 @@ def run_families_ab(families, base, videos, workdir: Path,
         "identical": _outputs_identical(seq_out,
                                         workdir / f"shared_r{last}"),
     }
+    if stages:
+        # last round's traces: one breakdown per sequential single-family
+        # arm plus the shared-decode run's
+        result["stages"] = {
+            "sequential": {fam: _stage_summary(workdir / f"seq_r{last}_{fam}")
+                           for fam in families},
+            "shared": _stage_summary(workdir / f"shared_r{last}"),
+        }
+    return result
 
 
 def main() -> None:
@@ -200,6 +244,11 @@ def main() -> None:
                          "ratio and bit-identity verdict)")
     ap.add_argument("--rounds", type=int, default=3,
                     help="A/B rounds for --families (medians)")
+    ap.add_argument("--stages", action="store_true",
+                    help="run with trace=true and print the per-stage "
+                         "decode/transform/h2d/device/write breakdown + "
+                         "X-bound verdict from the trace artifact next to "
+                         "each A/B line (roofline claims in one command)")
     # key=value / '::' tokens come back via parse_known_args, so --repeat
     # and --video are recognized wherever they appear on the command line
     opts, rest = ap.parse_known_args()
@@ -208,7 +257,7 @@ def main() -> None:
     if bad:
         raise SystemExit(f"unrecognized arguments: {bad} "
                          "(expected key=value, '::', --repeat, --video, "
-                         "--families, --rounds)")
+                         "--families, --rounds, --stages)")
     if opts.families and "::" in rest:
         raise SystemExit("--families is its own A/B; '::' groups don't "
                          "compose with it")
@@ -249,10 +298,12 @@ def main() -> None:
                 raise SystemExit("--families needs at least two "
                                  "comma-separated family names")
             print(json.dumps(run_families_ab(fams, configs[0], videos,
-                                             workdir, opts.rounds)))
+                                             workdir, opts.rounds,
+                                             stages=opts.stages)))
             return
         for i, cfg in enumerate(configs):
-            print(json.dumps(run_config(cfg, videos, workdir, str(i))))
+            print(json.dumps(run_config(cfg, videos, workdir, str(i),
+                                        stages=opts.stages)))
 
 
 if __name__ == "__main__":

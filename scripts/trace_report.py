@@ -10,8 +10,8 @@ at the run's output directory (or the ``_trace.json`` itself) and get
     (``fanout.put_blocked`` / ``fanout.get_starved`` /
     ``fanout.subscribe_wait`` / ``prefetch.put_blocked`` /
     ``retry_backoff``), each naming its family/video;
-  - **per-video critical path** — decode vs transform vs device vs write
-    time inside each ``video_attempt`` window, with a *-bound verdict
+  - **per-video critical path** — decode vs transform vs H2D vs device
+    vs write time inside each ``video_attempt`` window, with a *-bound verdict
     per video and for the whole run. This is the arithmetic behind
     docs/observability.md's diagnosis of the PR 3 "decode 2x, E2E ~1x"
     result.
@@ -30,9 +30,10 @@ the two captures is NOT attempted (start your capture with the run and
 read the overlap structurally, not by microsecond).
 
 Bucket heuristic for the verdict: ``forward`` spans are device time
-(under async dispatch: device *stall* time), ``write`` spans are sink
-IO, and ``decode`` spans split by thread — on the shared-decode bus
-thread (``vft-fanout-decode``) they are pure cv2 decode, on family/
+(under async dispatch: device *stall* time), ``h2d`` spans are the
+host->device staging copy (parallel/mesh.py dispatch), ``write`` spans
+are sink IO, and ``decode`` spans split by thread — on the shared-decode
+bus thread (``vft-fanout-decode``) they are pure cv2 decode, on family/
 prefetch/worker threads they are host transform work (in single-family
 runs, decode+transform conflated — the serial path times them as one
 stage).
@@ -59,8 +60,10 @@ from video_features_tpu.telemetry.trace import (  # noqa: E402
 #: decoder thread this); used to split "decode" into decode vs transform
 DECODE_THREAD_NAME = "vft-fanout-decode"
 
-#: stage-name -> report bucket (thread-dependent for "decode", see below)
-BUCKETS = ("decode", "transform", "device", "write", "stall")
+#: stage-name -> report bucket (thread-dependent for "decode", see below).
+#: "h2d" is the explicit host->device staging copy (parallel/mesh.py
+#: dispatch), "device" is forward/materialization stall, "write" sink IO.
+BUCKETS = ("decode", "transform", "h2d", "device", "write", "stall")
 
 #: umbrella spans bracket a whole job INCLUDING its idle waits — they
 #: cut windows (critical path) but must not count as busy time
@@ -177,6 +180,8 @@ def bucket_of(e: dict, names: Dict[int, str],
     n = e["name"]
     if n == "forward":
         return "device"
+    if n == "h2d":
+        return "h2d"
     if n == "write":
         return "write"
     if n in STALL_SPAN_NAMES:
@@ -224,6 +229,23 @@ def critical_path(xs: List[dict], names: Dict[int, str],
             + "  ".join(f"{per[b] / 1e3:9.1f}" for b in BUCKETS)
             + f"  {verdict}-bound")
     return lines, totals
+
+
+def stage_summary(path: str) -> dict:
+    """Run-wide per-stage totals for a trace artifact: bucket -> ms, plus
+    the bottleneck verdict. The programmatic face of this report — used
+    by ``scripts/throughput.py --stages`` and ``bench.py`` so roofline
+    claims ship the same arithmetic the interactive report prints."""
+    doc, _ = load_host_trace(path)
+    events = doc["traceEvents"]
+    names = thread_names(events)
+    xs = complete_events(events)
+    _, totals = critical_path(xs, names)
+    busy = {b: v for b, v in totals.items() if b != "stall"}
+    verdict = max(busy, key=busy.get) if any(busy.values()) else None
+    out = {f"{b}_ms": round(v / 1e3, 1) for b, v in totals.items()}
+    out["verdict"] = f"{verdict}-bound" if verdict else None
+    return out
 
 
 def merge_traces(host: dict, device: dict) -> dict:

@@ -1,0 +1,126 @@
+"""The resize=auto defaults flip (PR 6): device resize is the DEFAULT for
+file-sink runs, with automatic host fallback wherever the device path
+cannot serve.
+
+Fallback matrix pinned here (extractors/base.py _resolve_resize_mode):
+
+  - ``on_extraction=save_numpy``/``save_pickle``  -> device
+  - ``on_extraction=print``                       -> host (interactive /
+    parity path; the golden suite runs through it unchanged)
+  - ``show_pred=true``                            -> host (prediction
+    overlays need host-side frames)
+  - family without a fused device resize
+    (flow family, ``side_size=null``)             -> host
+  - explicit ``resize=host`` / ``resize=device``  -> honored as before
+  - bogus values                                  -> loud failure at
+    launch (sanity_check) and at init
+
+Plus the behavioral guarantees the flip rides on: the auto default's
+output is BIT-IDENTICAL to an explicit ``resize=device`` run, and the
+per-source-resolution runner cache still compiles one executable per
+geometry under the default (mixed-resolution corpus).
+"""
+import numpy as np
+import pytest
+
+from video_features_tpu.config import Config, load_config, sanity_check
+from video_features_tpu.extractors.base import BaseExtractor
+
+SAMPLE_KW = dict(video_paths="x.mp4", output_path="o", tmp_path="t")
+
+
+def _base(feature_type="resnet", **over):
+    args = Config(dict(feature_type=feature_type, device="cpu",
+                       **SAMPLE_KW, **over))
+    return BaseExtractor(args), args
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("over,capable,want", [
+    (dict(on_extraction="save_numpy"), True, "device"),
+    (dict(on_extraction="save_pickle"), True, "device"),
+    (dict(on_extraction="print"), True, "host"),
+    (dict(on_extraction="save_numpy", show_pred=True), True, "host"),
+    (dict(on_extraction="save_numpy"), False, "host"),  # no device resize
+    (dict(on_extraction="save_numpy", resize="host"), True, "host"),
+    (dict(on_extraction="print", resize="device"), True, "device"),
+    (dict(on_extraction="print", resize=None), True, "host"),  # null=auto
+])
+def test_auto_resolution_matrix(over, capable, want):
+    ex, args = _base(**over)
+    assert ex._resolve_resize_mode(args, device_capable=capable) == want
+
+
+@pytest.mark.quick
+def test_bogus_resize_fails_at_init_and_at_launch():
+    ex, args = _base(on_extraction="save_numpy", resize="gpu")
+    with pytest.raises(NotImplementedError):
+        ex._resolve_resize_mode(args)
+    cfg = load_config("resnet", {"resize": "gpu", **SAMPLE_KW})
+    with pytest.raises(ValueError):
+        sanity_check(cfg)
+
+
+@pytest.mark.quick
+def test_flow_family_without_side_size_falls_back_to_host(tmp_path,
+                                                          sample_video):
+    """A flow family with no resize in its pipeline at all must resolve
+    the auto default to host (there is nothing to move on-device)."""
+    from video_features_tpu.extractors.pwc import ExtractPWC
+    cfg = load_config("pwc", {
+        "video_paths": sample_video, "device": "cpu",
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    sanity_check(cfg)
+    assert cfg.get("side_size") is None
+    ex = ExtractPWC(cfg)
+    assert ex.resize_mode == "host"
+
+
+def _resnet(tmp_path, sample_video, sub, **over):
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    cfg = load_config("resnet", {
+        "video_paths": sample_video, "device": "cpu", "batch_size": 8,
+        "extraction_total": 6, "model_name": "resnet18",
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "output_path": str(tmp_path / sub / "o"),
+        "tmp_path": str(tmp_path / sub / "t"), **over,
+    })
+    sanity_check(cfg)
+    return ExtractResNet(cfg)
+
+
+def test_default_is_bit_identical_to_explicit_device(tmp_path, sample_video):
+    """The flipped default must be the SAME pipeline as resize=device —
+    not a third numeric path."""
+    auto = _resnet(tmp_path, sample_video, "auto")
+    assert auto.resize_mode == "device"
+    explicit = _resnet(tmp_path, sample_video, "dev", resize="device")
+    fa = auto.extract(sample_video)
+    fd = explicit.extract(sample_video)
+    np.testing.assert_array_equal(fa["resnet"], fd["resnet"])
+    np.testing.assert_array_equal(fa["timestamps_ms"], fd["timestamps_ms"])
+
+
+def test_mixed_resolutions_under_default(tmp_path, sample_video):
+    """Two source geometries through ONE extractor under the auto default:
+    one cached executable per resolution, finite features for both."""
+    import cv2
+    small = str(tmp_path / "small_res.mp4")
+    cap = cv2.VideoCapture(sample_video)
+    w = cv2.VideoWriter(small, cv2.VideoWriter_fourcc(*"mp4v"), 10.0,
+                        (160, 120))
+    for _ in range(12):
+        ok, frame = cap.read()
+        assert ok
+        w.write(cv2.resize(frame, (160, 120)))
+    cap.release()
+    w.release()
+
+    ex = _resnet(tmp_path, sample_video, "mixed", extraction_total=4)
+    assert ex.resize_mode == "device"
+    f1 = ex.extract(sample_video)["resnet"]
+    f2 = ex.extract(small)["resnet"]
+    assert np.isfinite(f1).all() and np.isfinite(f2).all()
+    assert len(ex._resize_runners) == 2  # one executable per geometry

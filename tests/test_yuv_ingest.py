@@ -1,0 +1,177 @@
+"""Raw-YUV ingest (PR 6): packed-I420 decode->device wire at 1.5 B/px.
+
+Contracts pinned here:
+
+  - ``channel_order='i420'`` delivery is bit-identical between a private
+    ``VideoSource`` and a FrameBus shared-decode subscription (packed
+    frames ride the union pass like any other order, converted at most
+    once per source frame);
+  - a shared-decode multi-family CLI run with ``ingest=yuv420`` produces
+    BIT-IDENTICAL outputs to the corresponding single-family runs at
+    ``video_workers`` 1 and 2 — the raw-I420 frame-wise wire (resnet:
+    full-res planes, colorspace+resize fused on device) and the
+    host-packed clip-stack wire (r21d: 112px crops packed after the host
+    transform) both covered;
+  - the raw-I420 device path reproduces the raw-BGR (``ingest=uint8``)
+    device-resize path's features on natural frames within the chroma
+    subsampling envelope (cosine > 0.999) for resnet AND clip — the
+    wire carries half the bytes, the features stay put;
+  - odd-dimension sources fall back to the BGR wire instead of failing.
+"""
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.parallel.fanout import FrameBus
+from video_features_tpu.utils.io import VideoSource
+
+
+@pytest.mark.quick
+def test_bus_i420_bit_identical_to_private_source(sample_video):
+    """FrameBus 'i420' subscribers get the exact packed planes a private
+    VideoSource would decode, alongside rgb/bgr siblings."""
+    specs = {
+        "a": dict(fps=2, transform=None, channel_order="i420"),
+        "b": dict(fps=1, transform=None, channel_order="bgr"),
+        "c": dict(total=5, transform=None, channel_order="rgb"),
+    }
+    bus = FrameBus(sample_video, list(specs), depth=8)
+    got, errs = {}, []
+
+    def consume(name, kw):
+        try:
+            sub = bus.subscribe(name, **kw)
+            got[name] = list(sub.frames())
+        except BaseException as e:
+            errs.append((name, e))
+
+    threads = [threading.Thread(target=consume, args=(n, kw))
+               for n, kw in specs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for name, kw in specs.items():
+        want = list(VideoSource(sample_video, **kw).frames())
+        assert len(got[name]) == len(want), name
+        for (xw, tw, iw), (xg, tg, ig) in zip(want, got[name]):
+            assert (tw, iw) == (tg, ig), name
+            np.testing.assert_array_equal(xw, xg, err_msg=name)
+    # the i420 wire really is the compressed one: 1.5 B/px vs 3
+    h, w = got["b"][0][0].shape[:2]
+    assert got["a"][0][0].shape == (h * 3 // 2, w)
+
+
+def _cli(args, cwd):
+    res = subprocess.run([sys.executable, "main.py"] + args, cwd=cwd,
+                         capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+#: cheap per-family budgets (1-core CI host); both families speak yuv420 —
+#: resnet ships raw full-res I420 (resize=device via the auto default),
+#: r21d packs its 112px crops host-side (clip-stack keeps host resize)
+OVERRIDES = ["resnet.model_name=resnet18", "resnet.batch_size=8",
+             "resnet.extraction_total=6", "r21d.extraction_fps=1",
+             "r21d.stack_size=10", "r21d.step_size=10"]
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_yuv420_shared_decode_bit_identical_to_singles(tmp_path,
+                                                       sample_video,
+                                                       workers):
+    base = ["device=cpu", "allow_random_weights=true", "ingest=yuv420",
+            "on_extraction=save_numpy", "retry_attempts=1",
+            f"tmp_path={tmp_path / 'tmp'}", f"video_paths={sample_video}",
+            ] + OVERRIDES
+    for fam in ("resnet", "r21d"):
+        single = [f"feature_type={fam}", "video_workers=1",
+                  f"output_path={tmp_path / 'single'}"]
+        # single-family overrides flatten (fam.key= -> key=)
+        single += [o.split(".", 1)[1] for o in OVERRIDES
+                   if o.startswith(f"{fam}.")]
+        _cli(single + [a for a in base if "." not in a.split("=")[0]], REPO)
+    _cli([f"feature_type=resnet,r21d", f"video_workers={workers}",
+          f"output_path={tmp_path / 'multi'}"] + base, REPO)
+
+    singles = sorted(p.relative_to(tmp_path / "single")
+                     for p in (tmp_path / "single").rglob("*.npy"))
+    multis = sorted(p.relative_to(tmp_path / "multi")
+                    for p in (tmp_path / "multi").rglob("*.npy"))
+    assert singles == multis and singles, (singles, multis)
+    for rel in singles:
+        np.testing.assert_array_equal(
+            np.load(tmp_path / "single" / rel),
+            np.load(tmp_path / "multi" / rel), err_msg=str(rel))
+
+
+@pytest.mark.parametrize("family", ["resnet", "clip"])
+def test_raw_i420_wire_matches_bgr_wire(tmp_path, sample_video, family):
+    """resize=device (the save-run default): ingest=yuv420's fused
+    I420->RGB->resize program reproduces the raw-BGR wire's features
+    within the 4:2:0 chroma envelope on natural frames."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.registry import get_extractor_cls
+
+    def run(mode, sub):
+        cfg = load_config(family, {
+            "video_paths": sample_video, "device": "cpu", "batch_size": 8,
+            "extraction_total": 4, "ingest": mode,
+            "on_extraction": "save_numpy", "allow_random_weights": True,
+            "output_path": str(tmp_path / sub / "o"),
+            "tmp_path": str(tmp_path / sub / "t"),
+        })
+        if family == "resnet":
+            cfg.model_name = "resnet18"
+        sanity_check(cfg)
+        ex = get_extractor_cls(family)(cfg)
+        assert ex.resize_mode == "device"  # the flipped default
+        return ex.extract(sample_video)[family]
+
+    ref = run("uint8", "u8")
+    got = run("yuv420", "yuv")
+    assert got.shape == ref.shape and ref.shape[0] > 0
+    cos = np.sum(ref * got, axis=1) / (
+        np.linalg.norm(ref, axis=1) * np.linalg.norm(got, axis=1) + 1e-9)
+    assert np.all(cos > 0.999), f"{family} raw-I420 diverged: cos={cos}"
+
+
+def test_odd_dimension_source_falls_back_to_bgr(tmp_path, sample_video,
+                                                monkeypatch, capsys):
+    """An odd-dimension source cannot pack I420; the video ships raw BGR
+    instead (same features, wider wire) rather than failing. Odd-width
+    mp4s can't be synthesized here (cv2's writer rounds the geometry
+    down), so the probe is patched to REPORT odd dims — which exercises
+    exactly the decision point the fallback lives on."""
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    from video_features_tpu.utils import io as vio
+
+    real_props = vio.get_video_props
+    monkeypatch.setattr(
+        vio, "get_video_props",
+        lambda p: {**real_props(p), "width": real_props(p)["width"] - 1})
+
+    cfg = load_config("resnet", {
+        "video_paths": sample_video, "device": "cpu", "batch_size": 4,
+        "extraction_total": 4, "model_name": "resnet18",
+        "ingest": "yuv420", "on_extraction": "save_numpy",
+        "allow_random_weights": True,
+        "output_path": str(tmp_path / "o"), "tmp_path": str(tmp_path / "t"),
+    })
+    sanity_check(cfg)
+    ex = ExtractResNet(cfg)
+    assert ex.resize_mode == "device"
+    assert ex._wire_order(sample_video) == "bgr"
+    assert "odd dimensions" in capsys.readouterr().out
+    # the full extract rides the BGR fallback wire (decode still yields
+    # the real even-geometry frames; only the wire decision was odd)
+    feats = ex.extract(sample_video)["resnet"]
+    assert feats.shape[0] == 4 and np.isfinite(feats).all()

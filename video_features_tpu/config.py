@@ -327,6 +327,28 @@ def sanity_check(args: Config) -> None:
                          "features into {output_path}/_health.jsonl and "
                          "quarantines NaN/Inf outputs, telemetry/health.py)")
 
+    # resize=auto|host|device (extractors/base.py _resolve_resize_mode):
+    # 'auto' (the default) picks 'device' for save sinks and 'host' for
+    # print/show_pred and for families without a fused device resize
+    rz = args.get("resize")
+    if rz is not None and rz not in ("auto", "host", "device"):
+        raise ValueError(f"resize={rz!r}: expected 'auto', 'host' or "
+                         "'device'")
+
+    # RAFT corr-lookup dispatch keys (models/raft.py configure_corr_lookup,
+    # applied at extractor init — the config-first promotion of the old
+    # trace-time env vars; VFT_CORR_LOOKUP/VFT_FUSE_CONVC1 stay as
+    # perf-probe overrides)
+    cli_impl = args.get("corr_lookup_impl")
+    if cli_impl is not None and cli_impl not in ("gather", "onehot",
+                                                 "pallas", "packed"):
+        raise ValueError(f"corr_lookup_impl={cli_impl!r}: expected null "
+                         "(auto), 'gather', 'onehot', 'pallas' or 'packed'")
+    fc1 = args.get("fuse_convc1")
+    if fc1 is not None and not isinstance(fc1, bool):
+        raise ValueError(f"fuse_convc1={fc1!r}: expected true, false or "
+                         "null (auto)")
+
     fps_mode = args.get("fps_mode", "select") or "select"
     if fps_mode not in ("select", "reencode"):
         raise ValueError(

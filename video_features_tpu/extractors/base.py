@@ -159,18 +159,33 @@ class BaseExtractor:
             return runner.stream(depth=0, callback=on_result)
         return runner.stream(depth=depth)
 
-    def _resolve_resize_mode(self, args: Config) -> str:
-        """Shared ``resize=host|device`` validation + the per-source-
+    def _resolve_resize_mode(self, args: Config,
+                             device_capable: bool = True) -> str:
+        """Shared ``resize=auto|host|device`` validation + the per-source-
         resolution runner cache used by every device-resize pipeline
         (frame-wise, flow, i3d): a lock-guarded (video_workers share it)
-        FIFO-bounded dict keyed by source (h, w)."""
+        FIFO-bounded dict keyed by source (h, w).
+
+        ``auto`` (the config default since the defaults flip) resolves to
+        ``device`` — the measured ~3x host frame-rate lever, within 2 LSB
+        of PIL (docs/performance.md §"Device resize") — for file-sink runs
+        of families with a fused device resize, and falls back to ``host``
+        for ``print``/``show_pred`` runs (the interactive/parity paths,
+        which need host-side frames) and for ``device_capable=False``
+        families (e.g. a flow family without ``side_size`` has no resize
+        in the pipeline at all). Explicit ``host``/``device`` are honored
+        as before."""
         import threading
-        mode = args.get("resize") or "host"
-        if mode not in ("host", "device"):
-            raise NotImplementedError(f"resize={mode!r}: expected 'host' "
-                                      "or 'device'")
+        mode = args.get("resize") or "auto"
+        if mode not in ("auto", "host", "device"):
+            raise NotImplementedError(f"resize={mode!r}: expected 'auto', "
+                                      "'host' or 'device'")
         self._resize_runners: Dict = {}
         self._resize_lock = threading.Lock()
+        if mode == "auto":
+            save_sink = self.on_extraction in ("save_numpy", "save_pickle")
+            mode = ("device" if device_capable and save_sink
+                    and not self.show_pred else "host")
         return mode
 
     def _cached_resize_runner(self, key, build):

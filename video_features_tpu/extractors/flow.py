@@ -54,10 +54,13 @@ class OpticalFlowExtractor(BaseExtractor):
         #: resize=device (only meaningful with side_size): the per-frame PIL
         #: edge resize moves onto the MXU in front of the flow net; the host
         #: ships raw decoded frames. At small side_size the flow nets outrun
-        #: a CPU core's PIL filtering, so this keeps the chip fed.
-        self.resize_mode = self._resolve_resize_mode(args)
+        #: a CPU core's PIL filtering, so this keeps the chip fed. Without
+        #: side_size there is no resize in the pipeline at all, so the
+        #: 'auto' default resolves to host.
+        self.resize_mode = self._resolve_resize_mode(
+            args, device_capable=self.side_size is not None)
         if self.side_size is None:
-            self.resize_mode = "host"  # no resize in the pipeline at all
+            self.resize_mode = "host"  # explicit resize=device: no-op too
         if self.resize_mode == "device" and self.show_pred:
             # show_pred overlays flow on the (resized) RGB frames, which the
             # host no longer has under device resize

@@ -144,6 +144,11 @@ class FlowStream:
                 f"flow_stack_batch={self.stack_batch}: need >= 1 or 'auto'")
         crop = parent.central_crop_size
         if parent.flow_type == "raft":
+            # corr-lookup dispatch from config keys (validated in
+            # sanity_check), installed before the first traced forward —
+            # env vars stay perf-probe overrides (models/raft.py)
+            raft_model.configure_corr_lookup(args.get("corr_lookup_impl"),
+                                             args.get("fuse_convc1"))
             # the reference hardcodes the sintel checkpoint for the i3d flow
             # sub-model (extract_i3d.py:178); flow_iters trades flow accuracy
             # for speed (fewer GRU refinement steps) — default is the

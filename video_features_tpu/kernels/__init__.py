@@ -8,9 +8,12 @@ grid_sample gather (reference models/raft/raft_src/corr.py:29-50). Here:
 
   - :mod:`corr_lookup` — the windowed bilinear pyramid lookup recast as
     one-hot matmul contractions (gather-free, rides the MXU), as a fused
-    Pallas kernel and a pure-XLA twin. Selected by ``VFT_CORR_LOOKUP``
-    in models/raft.py — ``pallas`` (TPU default, the 20x one) |
-    ``onehot`` | ``gather`` (CPU default); read at trace time.
+    Pallas kernel and a pure-XLA twin. Selected by the
+    ``corr_lookup_impl`` config key (models/raft.py
+    configure_corr_lookup, applied at extractor init; the
+    ``VFT_CORR_LOOKUP`` env var is the trace-time override) —
+    ``pallas`` (TPU default, the 20x one) | ``onehot`` | ``gather``
+    (CPU default).
   - :mod:`cost_volume` — the 81-channel windowed cost volume as the XLA
     shifted-window formulation. A Pallas twin was built, hardware-
     validated, measured TIED with XLA across every real PWC shape in f32

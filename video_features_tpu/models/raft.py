@@ -276,25 +276,60 @@ def _pallas_supported(pyramid: Sequence[jnp.ndarray]) -> bool:
     return pallas_lookup_supported(pyramid)
 
 
+#: process-level corr-lookup dispatch defaults, set from CONFIG KEYS
+#: (``corr_lookup_impl`` / ``fuse_convc1`` in raft.yml / i3d.yml) at
+#: extractor init via :func:`configure_corr_lookup` — i.e. before the
+#: first traced forward, by construction
+_CORR_CONFIG: dict = {"impl": None, "fuse_convc1": None}
+
+_CORR_IMPLS = ("gather", "onehot", "pallas", "packed")
+
+
+def configure_corr_lookup(impl=None, fuse_convc1=None) -> None:
+    """Install the config-level corr-lookup dispatch choice.
+
+    Called by the RAFT-bearing extractors at init with the validated
+    ``corr_lookup_impl``/``fuse_convc1`` config keys. ``None`` leaves a
+    knob at its platform auto choice (Pallas+fused on TPU, gather
+    elsewhere). The ``VFT_CORR_LOOKUP``/``VFT_FUSE_CONVC1`` env vars
+    remain the highest-precedence override — for trace-time perf probes
+    (scripts/bench_i3d_variants.py A/Bs) — but config, not environment,
+    is now the supported interface, and it is applied before anything
+    can have been traced."""
+    if impl is not None:
+        if impl not in _CORR_IMPLS:
+            raise ValueError(f"corr_lookup_impl={impl!r}: expected one of "
+                             f"{_CORR_IMPLS} or null (auto)")
+        _CORR_CONFIG["impl"] = impl
+    if fuse_convc1 is not None:
+        _CORR_CONFIG["fuse_convc1"] = bool(fuse_convc1)
+
+
 def _corr_impl() -> str:
-    """Trace-time corr-lookup implementation choice (see corr_lookup)."""
+    """Corr-lookup implementation choice, resolved at trace time:
+    env override > config key (configure_corr_lookup) > platform auto."""
     import os
     impl = os.environ.get("VFT_CORR_LOOKUP", "").strip().lower()
     if not impl:
-        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
-    if impl not in ("gather", "onehot", "pallas", "packed"):
+        impl = _CORR_CONFIG["impl"] or (
+            "pallas" if jax.default_backend() == "tpu" else "gather")
+    if impl not in _CORR_IMPLS:
         raise ValueError(f"VFT_CORR_LOOKUP={impl!r}: expected "
                          "'gather', 'onehot', 'pallas' or 'packed'")
     return impl
 
 
 def _fuse_convc1() -> bool:
-    """Trace-time switch for the fused lookup+convc1 kernel on the pallas
-    path (default ON; ``VFT_FUSE_CONVC1=0`` opts out to the per-level
-    unfused kernels — the round-3 configuration, kept for A/B)."""
+    """Fused lookup+convc1 kernel switch on the pallas path (default ON;
+    false opts out to the per-level unfused kernels — the round-3
+    configuration, kept for A/B). Same precedence as :func:`_corr_impl`:
+    env override > config key > auto."""
     import os
-    return os.environ.get("VFT_FUSE_CONVC1", "1").strip().lower() not in (
-        "0", "false", "no")
+    env = os.environ.get("VFT_FUSE_CONVC1", "").strip().lower()
+    if env:
+        return env not in ("0", "false", "no")
+    cfg = _CORR_CONFIG["fuse_convc1"]
+    return True if cfg is None else cfg
 
 
 def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
@@ -307,14 +342,16 @@ def corr_lookup(pyramid: Sequence[jnp.ndarray], coords: jnp.ndarray,
     fused Pallas kernel — the RAFT scan path, where the pack is hoisted out
     of the 20-iteration GRU loop.
 
-    ``VFT_CORR_LOOKUP`` selects ``gather``, ``onehot``, ``pallas`` or
-    ``packed`` (kernels/corr_lookup.py; ``packed`` is the lane-dense
-    fused-kernel alternative kept as a measured negative result — ~10%
-    slower end-to-end than ``pallas`` on v5e despite 5.8x fewer DMA
-    bytes). Unset picks ``pallas`` on TPU and ``gather`` elsewhere. The
-    env var is read at TRACE time: it must be set before the first RAFT
-    forward of the process — once the jitted scan body is compiled,
-    changing it has no effect (same caveat as every static jit switch).
+    The ``corr_lookup_impl`` CONFIG key selects ``gather``, ``onehot``,
+    ``pallas`` or ``packed`` (kernels/corr_lookup.py; ``packed`` is the
+    lane-dense fused-kernel alternative kept as a measured negative
+    result — ~10% slower end-to-end than ``pallas`` on v5e despite 5.8x
+    fewer DMA bytes). Unset picks ``pallas`` on TPU and ``gather``
+    elsewhere. The key is validated at launch (config.sanity_check) and
+    installed at extractor init (:func:`configure_corr_lookup`) — before
+    the first traced forward, so there is no set-before-first-trace
+    ordering to get wrong. ``VFT_CORR_LOOKUP`` remains the
+    highest-precedence override for in-process perf probes.
 
     Measured END-TO-END on TPU v5e with a D2H-fenced timer
     (parallel/mesh.py settle — block_until_ready acks early through dev

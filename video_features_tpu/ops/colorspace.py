@@ -46,6 +46,39 @@ def rgb_to_yuv420(frame_u8: np.ndarray) -> np.ndarray:
     return cv2.cvtColor(frame_u8, cv2.COLOR_RGB2YUV_I420).reshape(-1)
 
 
+def bgr_to_yuv420_frame(frame_bgr: np.ndarray) -> np.ndarray:
+    """Decoder-native BGR uint8 (H, W, 3) -> cv2-layout packed I420
+    (H*3/2, W) uint8 — the raw-YUV wire frame of ``ingest=yuv420`` under
+    ``resize=device``.
+
+    This is the ONE per-frame host conversion the raw-ingest decode path
+    pays, replacing (not adding to) the BGR->RGB reorder: its output is
+    1.5 bytes/pixel instead of 3, so every downstream copy — fan-out
+    queue, prefetch queue, np.stack, and above all the H2D transfer —
+    moves half the bytes of a raw uint8 RGB frame and an eighth of the
+    float32 wire the reference shipped."""
+    import cv2
+    h, w = frame_bgr.shape[:2]
+    packed_size(h, w)  # validates evenness
+    return cv2.cvtColor(frame_bgr, cv2.COLOR_BGR2YUV_I420)
+
+
+def yuv420_frame_to_rgb_u8(packed_2d, h: int, w: int):
+    """cv2-layout packed I420 (..., H*3/2, W) uint8 -> (..., H, W, 3)
+    uint8 RGB on device. Jittable.
+
+    Rounds the BT.601 float conversion back onto the uint8 lattice so the
+    downstream device resize (ops/preprocess.py device_resize) sees an
+    integer-valued image exactly like the host decoder would have handed
+    it — cv2's own BGR output differs from this reconstruction by < 1
+    intensity level (see module docstring)."""
+    import jax.numpy as jnp
+    lead = packed_2d.shape[:-2]
+    flat = packed_2d.reshape(*lead, h * w * 3 // 2)
+    rgb = yuv420_packed_to_rgb(flat, h, w)
+    return jnp.round(rgb).astype(jnp.uint8)
+
+
 def yuv420_packed_to_rgb(packed, h: int, w: int):
     """Packed I420 uint8 (..., H*W*3/2) -> float32 RGB (..., H, W, 3) in
     [0, 255]. Jittable; shapes are static. Matches cv2 YUV2RGB_I420

@@ -57,13 +57,13 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
-import cv2
 import numpy as np
 
 from .. import telemetry
 from ..telemetry import trace
 from ..utils.faults import DeadlineExceeded
-from ..utils.io import (_batched, _FrameStream, count_frames_by_decode,
+from ..utils.io import (CHANNEL_ORDERS, _batched, _FrameStream,
+                        convert_decoded, count_frames_by_decode,
                         get_video_props, plan_frame_selection)
 
 #: default per-subscriber queue depth (raw decoded frames; a 320x240
@@ -107,7 +107,7 @@ class SharedFrameSource:
         import queue as _queue
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
-        assert channel_order in ("rgb", "bgr"), channel_order
+        assert channel_order in CHANNEL_ORDERS, channel_order
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
         self.bus = bus
@@ -496,20 +496,21 @@ class FrameBus:
                 if not ok:
                     break  # EOF (possibly before the plans: see below)
                 if frame is not None:
-                    rgb = None
+                    # each delivery format ('rgb' reorder / 'i420' pack) is
+                    # converted AT MOST ONCE per source frame no matter how
+                    # many subscribers want it; 'bgr' shares the decoder's
+                    # native buffer with zero conversion
+                    by_order = {"bgr": frame}
                     for s, outs in wants:
                         if s.closed:
                             continue
-                        if s.channel_order == "rgb":
-                            if rgb is None:
-                                t1 = time.perf_counter()
-                                with profiler.stage("decode"):
-                                    rgb = cv2.cvtColor(frame,
-                                                       cv2.COLOR_BGR2RGB)
-                                self._decode_s += time.perf_counter() - t1
-                            arr = rgb
-                        else:
-                            arr = frame  # decoder-native BGR, shared
+                        arr = by_order.get(s.channel_order)
+                        if arr is None:
+                            t1 = time.perf_counter()
+                            with profiler.stage("decode"):
+                                arr = convert_decoded(frame, s.channel_order)
+                            self._decode_s += time.perf_counter() - t1
+                            by_order[s.channel_order] = arr
                         for out_idx in outs:
                             if not s._push(("frame", (arr, out_idx))):
                                 break  # subscriber abandoned mid-frame

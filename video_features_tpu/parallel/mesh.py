@@ -274,8 +274,22 @@ class DataParallelApply:
         """Pad + enqueue the jitted forward; returns the device array
         WITHOUT synchronizing (JAX dispatch is async — the host thread is
         free as soon as the computation is enqueued). Padded rows are NOT
-        dropped; callers track validity (see :class:`FeatureStream`)."""
-        return self._fn(self.params, self._pad(batch_np))
+        dropped; callers track validity (see :class:`FeatureStream`).
+
+        Host batches go through an explicit ``device_put`` under an
+        ``h2d`` profiler stage, so the per-stage breakdown (profile=true,
+        trace=true, scripts/throughput.py --stages) can attribute wire
+        time separately from decode/transform and device compute. The put
+        is what the jit's implicit transfer would have done anyway — on
+        accelerators the DMA completes asynchronously, so the stage times
+        the host-side staging copy + enqueue (a lower bound on wire
+        time); on CPU it is the full copy."""
+        padded = self._pad(batch_np)
+        if not isinstance(padded, jax.Array):
+            from ..utils.profiling import profiler
+            with profiler.stage("h2d"):
+                padded = jax.device_put(padded, self._batch_sharding)
+        return self._fn(self.params, padded)
 
     def __call__(self, batch_np: np.ndarray, n_valid: Optional[int] = None
                  ) -> np.ndarray:

@@ -232,7 +232,14 @@ class ClipPacker:
             # materialize it, and un-poisoned members would spin in
             # close_video forever instead of surfacing the error
             try:
-                host = np.asarray(dev)  # blocking D2H
+                from ..utils.profiling import profiler
+                # same stage contract as FeatureStream._pop: under async
+                # dispatch this is the host's *stall* time on the device,
+                # which is what the per-stage roofline breakdown
+                # (trace_report / bench_pipeline) needs attributed —
+                # without it a packed run's device time is invisible
+                with profiler.stage("forward"):
+                    host = np.asarray(dev)  # blocking D2H
                 with self._lock:
                     for row, (h, idx) in enumerate(manifest):
                         if h in self._results:

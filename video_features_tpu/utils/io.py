@@ -225,6 +225,25 @@ def reencode_video_with_diff_fps(video_path: Union[str, Path],
     return new_path
 
 
+#: channel orders a decoded stream can deliver: 'rgb' (converted), 'bgr'
+#: (decoder-native, conversion deferred/skipped), 'i420' (packed
+#: (H*3/2, W) YUV 4:2:0 planes at 1.5 B/px — the raw-YUV ingest wire,
+#: colorspace conversion fused on device via ops/colorspace.py)
+CHANNEL_ORDERS = ("rgb", "bgr", "i420")
+
+
+def convert_decoded(frame_bgr: np.ndarray, channel_order: str) -> np.ndarray:
+    """Decoder-native BGR frame -> the requested delivery format (the one
+    shared conversion point of the serial, segment-worker and fan-out
+    decode paths, so they cannot drift)."""
+    if channel_order == "bgr":
+        return frame_bgr
+    if channel_order == "i420":
+        from ..ops.colorspace import bgr_to_yuv420_frame
+        return bgr_to_yuv420_frame(frame_bgr)
+    return cv2.cvtColor(frame_bgr, cv2.COLOR_BGR2RGB)
+
+
 class _FrameStream:
     """Sequential decoder with the missing-frame-0 workaround.
 
@@ -235,13 +254,21 @@ class _FrameStream:
     112px crop instead of a full-resolution conversion pass per frame —
     with bit-identical results (channel reorder commutes with per-channel
     ops). The r21d/s3d host transforms use this.
+
+    ``channel_order='i420'`` yields packed YUV 4:2:0 planes in cv2's
+    (H*3/2, W) layout: ONE ``BGR2YUV_I420`` conversion replaces the
+    BGR->RGB reorder and every downstream buffer carries 1.5 bytes/pixel
+    instead of 3 — the raw-YUV ingest wire (``ingest=yuv420`` with
+    ``resize=device``), converted back to RGB on device
+    (ops/colorspace.py). Requires even frame dimensions (I420 chroma
+    subsampling).
     """
 
     def __init__(self, path: str, channel_order: str = "rgb"):
-        assert channel_order in ("rgb", "bgr"), channel_order
+        assert channel_order in CHANNEL_ORDERS, channel_order
         self.cap = cv2.VideoCapture(path)
         self._first = True
-        self._native = channel_order == "bgr"
+        self._order = channel_order
 
     def read(self) -> Optional[np.ndarray]:
         # local ref: a concurrent release() (deadline watchdog) nulls
@@ -258,9 +285,7 @@ class _FrameStream:
         self._first = False
         if not ok:
             return None
-        if self._native:
-            return frame
-        return cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+        return convert_decoded(frame, self._order)
 
     def skip(self) -> bool:
         """Advance one frame WITHOUT materializing it: ``grab()`` demuxes
@@ -312,7 +337,7 @@ class VideoSource:
         # eager: _FrameStream re-checks lazily at first decode, but that
         # fires inside a worker thread as a per-video failure, far from the
         # misconfigured call site
-        assert channel_order in ("rgb", "bgr"), channel_order
+        assert channel_order in CHANNEL_ORDERS, channel_order
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
         if fps_mode not in ("select", "reencode"):
@@ -749,10 +774,8 @@ def _segment_decode_worker(q, path: str, seg: dict) -> None:
                             print("Detect missing frame")
                             ok, frame = cap.read()
                         if ok:
-                            if seg["channel_order"] != "bgr":
-                                frame = cv2.cvtColor(frame,
-                                                     cv2.COLOR_BGR2RGB)
-                            current = frame
+                            current = convert_decoded(
+                                frame, seg["channel_order"])
                     if not ok:
                         q.put(("done", emitted))
                         return

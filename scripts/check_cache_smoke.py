@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Feature-cache quick-gate: a tiny corpus extracted twice with
+``cache=true`` must end pass 2 at a 100% hit rate with bit-identical
+outputs (ISSUE 7).
+
+Fourth sibling of the ``check_*_schema.py`` gates, for the
+content-addressed feature cache (cache.py). One dynamic half only — the
+cache has no schema artifact to pin, its contract IS the two-pass
+behavior:
+
+  1. pass 1 (cold store, byte-identical copies): the FIRST video misses
+     and computes; the second is deduplicated against it IN-PASS (the
+     content hash doesn't care that the stem differs) — 1 miss + 1 hit
+     in the heartbeat's ``cache`` section;
+  2. pass 2 (warm, fresh output dir so the filename skip cannot mask the
+     cache path): every video hits — ``hit_rate == 1.0``, zero misses —
+     and every written artifact is byte-identical to pass 1's.
+
+A hit that served different bytes, or a second pass that silently
+recomputed, fails loudly here before it can ship. Exit 0 = contract
+holds; exit 1 = every violation listed. Runs in the CI quick tier
+(.github/workflows/ci.yml); the in-suite twin is
+tests/test_cache.py::test_cli_two_pass_all_hits_bit_identical, and
+``python bench.py bench_cache`` measures the same shape as a ratio.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+N_VIDEOS = 2
+
+
+def check_two_pass(td: Path) -> List[str]:
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    vids = []
+    for i in range(N_VIDEOS):
+        dst = td / f"smoke{i}.mp4"
+        shutil.copy(SAMPLE, dst)
+        vids.append(str(dst))
+    base = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_total=6", "batch_size=8", "telemetry=true",
+            "video_workers=1",  # deterministic in-pass dedup ordering
+            "cache=true", f"cache_dir={td / 'store'}",
+            f"tmp_path={td / 'tmp'}",
+            "video_paths=[" + ",".join(vids) + "]"]
+    with contextlib.redirect_stdout(io.StringIO()):
+        cli_main(base + [f"output_path={td / 'p1'}"])
+        cli_main(base + [f"output_path={td / 'p2'}"])
+
+    def heartbeat_cache(out: Path) -> dict:
+        hbs = sorted(out.rglob("_heartbeat_*.json"))
+        if not hbs:
+            return {}
+        return json.loads(hbs[0].read_text()).get("cache") or {}
+
+    c1 = heartbeat_cache(td / "p1")
+    # the copies are byte-identical: video 1 computes, video 2 dedups
+    # against it WITHIN the cold pass — the content hash is the identity,
+    # not the filename
+    if c1.get("misses") != {"resnet": 1} or c1.get("hits") != {"resnet": 1}:
+        errs.append("pass 1 expected 1 miss + 1 in-pass dedup hit, "
+                    f"heartbeat cache section says {c1!r}")
+    c2 = heartbeat_cache(td / "p2")
+    if c2.get("hits") != {"resnet": N_VIDEOS}:
+        errs.append(f"pass 2 expected {N_VIDEOS} hits (100%), heartbeat "
+                    f"cache section says {c2!r}")
+    if c2.get("hit_rate") != 1.0:
+        errs.append(f"pass 2 hit_rate {c2.get('hit_rate')!r} != 1.0")
+
+    p1 = sorted(p.relative_to(td / "p1")
+                for p in (td / "p1").rglob("*.npy"))
+    p2 = sorted(p.relative_to(td / "p2")
+                for p in (td / "p2").rglob("*.npy"))
+    if p1 != p2 or len(p1) < N_VIDEOS:
+        errs.append(f"artifact sets diverged: pass1={len(p1)} "
+                    f"pass2={len(p2)} files")
+    for rel in p1:
+        if rel in p2 and (td / "p1" / rel).read_bytes() != \
+                (td / "p2" / rel).read_bytes():
+            errs.append(f"{rel}: pass-2 bytes differ from pass 1 — a "
+                        "cache hit served different features")
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"SKIP: vendored sample missing ({SAMPLE})")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_cache_smoke_") as td:
+        errs = check_two_pass(Path(td))
+    if errs:
+        print("CACHE SMOKE: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"CACHE SMOKE: OK ({N_VIDEOS} videos x 2 passes, 100% pass-2 "
+          "hits, bit-identical artifacts)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

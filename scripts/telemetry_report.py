@@ -137,6 +137,18 @@ def render_heartbeats(paths: List[str], now: float,
             lines.append("    last interval: " + ", ".join(
                 f"{k}={v.get('s', 0):.2f}s/{v.get('calls', 0)}c"
                 for k, v in sorted(delta.items())))
+        # WHY work was avoided (cache.py): hits consulted the store and
+        # matched; bypasses are the filename skip-if-exists check (which
+        # runs with cache=false too) — precedence is cache hit > filename
+        # skip (docs/performance.md "Never compute twice")
+        ca = hb.get("cache") or {}
+        tallies = [(k, sum((ca.get(k) or {}).values()))
+                   for k in ("hits", "misses", "bypasses")]
+        if any(n for _, n in tallies):
+            rate = ca.get("hit_rate")
+            lines.append("    cache: " + ", ".join(
+                f"{k}={n}" for k, n in tallies)
+                + (f", hit_rate={rate}" if rate is not None else ""))
     return lines
 
 

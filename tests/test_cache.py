@@ -1,0 +1,306 @@
+"""Content-addressed feature cache (cache.py): keying, verify-before-
+trust serving, extractor wiring, and the two-pass CLI contract (ISSUE 7).
+
+Contracts pinned here:
+  - the store key changes exactly when a feature VALUE could change:
+    input bytes, a semantic config key, or a weights sha — and does NOT
+    change for operational knobs (output paths, worker counts,
+    telemetry switches) or for a default that resolves to the same
+    value an explicit setting names (``resize=auto`` ≡ ``resize=device``
+    on a save run);
+  - a hit never decodes: the second byte-identical run is served with
+    the extractor's decode/forward path provably never entered;
+  - serving is verify-before-trust: an entry whose bytes are torn, whose
+    schema is stale, or whose tensors fail the quantization-tolerant
+    content signature (telemetry/health.py) is deleted and reported as
+    a miss — corrupted features are never served;
+  - two CLI passes over the same corpus with ``cache=true`` end with
+    pass 2 at a 100% hit rate (heartbeat ``cache`` section) and outputs
+    bit-identical to pass 1 (the CI smoke's in-suite twin).
+"""
+import os
+import pickle
+import shutil
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu import cache as fcache
+
+pytestmark = pytest.mark.quick
+
+
+# -- identity components ----------------------------------------------------
+
+def test_file_sha256_memoizes_and_tracks_content(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(b"x" * 4096)
+    first = fcache.file_sha256(str(p))
+    assert first == fcache.file_sha256(str(p))  # memo path
+    # new content (and a new mtime_ns/size key) must re-hash, not re-serve
+    p.write_bytes(b"y" * 4097)
+    assert fcache.file_sha256(str(p)) != first
+
+
+def test_content_identity_sha_fast_path_and_plan_fallback(
+        sample_video, monkeypatch):
+    cid = fcache.content_identity(sample_video)
+    assert cid.startswith("sha256:")
+    # unreadable bytes (pipe/device sources) fall back to the decode-plan
+    # identity: probed props + the exact plan_frame_selection mapping
+    monkeypatch.setattr(fcache, "file_sha256",
+                        lambda p: (_ for _ in ()).throw(OSError("no bytes")))
+    pid = fcache.content_identity(sample_video, fps=4.0)
+    assert pid.startswith("plan:")
+    # the plan identity is deterministic and fps-sensitive
+    assert pid == fcache.content_identity(sample_video, fps=4.0)
+    assert pid != fcache.content_identity(sample_video, fps=2.0)
+
+
+def test_config_fingerprint_operational_keys_do_not_key(tmp_path):
+    base = {"feature_type": "resnet", "model_name": "resnet18",
+            "extraction_fps": 4, "batch_size": 16,
+            "output_path": "./output", "video_workers": 1,
+            "telemetry": False, "cache": True, "cache_dir": None}
+    fp = fcache.config_fingerprint(base)
+    ops = dict(base, output_path=str(tmp_path), video_workers=8,
+               telemetry=True, trace=True, retry_attempts=5,
+               cache_dir=str(tmp_path / "c"))
+    assert fcache.config_fingerprint(ops) == fp
+    # batch_size is scheduling, not semantics (same math, wider groups)
+    assert fcache.config_fingerprint(dict(base, batch_size=64)) == fp
+    # semantic keys DO key
+    assert fcache.config_fingerprint(dict(base, extraction_fps=2)) != fp
+    assert fcache.config_fingerprint(
+        dict(base, model_name="resnet50")) != fp
+    # resolved overlays replace the raw key: auto == its resolution
+    assert fcache.config_fingerprint(dict(base, resize="auto"),
+                                     {"resize": "device"}) \
+        == fcache.config_fingerprint(dict(base, resize="device"),
+                                     {"resize": "device"})
+
+
+def test_weights_fingerprint_sha_sensitive_order_insensitive():
+    a = {"model_key": "resnet18", "sha256": "a" * 64}
+    b = {"model_key": "vggish", "sha256": "b" * 64}
+    fp = fcache.weights_fingerprint([a, b])
+    assert fp == fcache.weights_fingerprint([b, a])
+    assert fp != fcache.weights_fingerprint(
+        [dict(a, sha256="c" * 64), b])
+    assert fcache.weights_fingerprint(
+        [{"model_key": "resnet18", "random": True}]) != \
+        fcache.weights_fingerprint([a])
+    assert fcache.weights_fingerprint(None) == "none"
+
+
+# -- store: roundtrip + verify-before-trust ---------------------------------
+
+@pytest.fixture
+def store(tmp_path):
+    """A FeatureCache over a content file that needs no video decode:
+    key_for only reads bytes on the sha256 fast path."""
+    content = tmp_path / "input.mp4"
+    content.write_bytes(os.urandom(1 << 14))
+    fc = fcache.FeatureCache(str(tmp_path / "cache"), "resnet",
+                             "cfg" + "0" * 61, "wts" + "0" * 61)
+    return fc, str(content)
+
+
+def _feats(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"resnet": rng.standard_normal((7, 512)).astype(np.float32),
+            "fps": np.float64(4.0),
+            "timestamps_ms": (np.arange(7) * 250.0)}
+
+
+def test_store_lookup_roundtrip_bit_identical(store):
+    fc, video = store
+    feats = _feats()
+    key = fc.store(video, feats)
+    assert os.path.exists(fc.entry_path(key))
+    got = fc.lookup(video, expected_keys=list(feats))
+    assert got is not None and set(got) == set(feats)
+    for k in feats:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(feats[k]), err_msg=k)
+
+
+def test_lookup_misses_on_absent_and_on_key_mismatch(store):
+    fc, video = store
+    assert fc.lookup(video) is None  # nothing stored yet
+    key = fc.store(video, _feats())
+    # an entry whose key set doesn't match the extractor's contract is
+    # dropped, not partially served
+    assert fc.lookup(video, expected_keys=["resnet", "fps"]) is None
+    assert not os.path.exists(fc.entry_path(key))
+
+
+def test_corrupted_tensor_fails_signature_and_is_dropped(store):
+    fc, video = store
+    key = fc.store(video, _feats())
+    path = fc.entry_path(key)
+    with open(path, "rb") as f:
+        entry = pickle.load(f)
+    # bit rot past the quantization lattice, sigs left stale
+    entry["feats"]["resnet"] = entry["feats"]["resnet"] + 0.1
+    with open(path, "wb") as f:
+        pickle.dump(entry, f)
+    assert fc.lookup(video, expected_keys=list(_feats())) is None
+    assert not os.path.exists(path)  # dropped, so a recompute repopulates
+
+
+def test_torn_entry_and_stale_schema_are_misses(store):
+    fc, video = store
+    key = fc.store(video, _feats())
+    path = fc.entry_path(key)
+    Path(path).write_bytes(b"\x80\x04 torn pickle")
+    assert fc.lookup(video) is None and not os.path.exists(path)
+    key = fc.store(video, _feats())
+    path = fc.entry_path(key)
+    with open(path, "rb") as f:
+        entry = pickle.load(f)
+    entry["schema"] = "vft.feature_cache/0"
+    with open(path, "wb") as f:
+        pickle.dump(entry, f)
+    assert fc.lookup(video) is None and not os.path.exists(path)
+
+
+def test_different_content_different_key(store, tmp_path):
+    fc, video = store
+    other = tmp_path / "other.mp4"
+    other.write_bytes(os.urandom(1 << 14))
+    assert fc.key_for(video) != fc.key_for(str(other))
+
+
+# -- extractor wiring -------------------------------------------------------
+
+def _resnet_cfg(sample_video, out, cache_dir, **over):
+    from video_features_tpu.config import load_config, sanity_check
+    cfg = load_config("resnet", {
+        "video_paths": sample_video, "device": "cpu", "batch_size": 8,
+        "extraction_total": 6, "model_name": "resnet18",
+        "on_extraction": "save_numpy", "allow_random_weights": True,
+        "cache": True, "cache_dir": str(cache_dir),
+        "output_path": str(out / "out"), "tmp_path": str(out / "tmp"),
+        **over,
+    })
+    sanity_check(cfg)
+    return cfg
+
+
+def test_hit_on_byte_identical_rerun_never_decodes(sample_video, tmp_path):
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    cache_dir = tmp_path / "cache"
+    ex1 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "a", cache_dir))
+    feats = ex1._extract(sample_video)
+    assert feats is not None
+    # fresh extractor, fresh OUTPUT dir (so the filename skip cannot mask
+    # the cache path), same cache root: the hit must serve without ever
+    # entering decode/forward
+    ex2 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "b", cache_dir))
+    def _boom(_):
+        raise AssertionError("cache hit must not decode")
+    ex2.extract = _boom
+    got = ex2._extract(sample_video)
+    assert got is not None
+    for k in feats:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(feats[k]), err_msg=k)
+    # ... and the hit still materialized the sink artifacts in dir b
+    stem = Path(sample_video).stem
+    assert list((tmp_path / "b" / "out").rglob(f"{stem}_resnet.npy"))
+
+
+def test_miss_on_semantic_config_change(sample_video, tmp_path):
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    cache_dir = tmp_path / "cache"
+    ex1 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "a", cache_dir))
+    ex1._extract(sample_video)
+    # extraction_total=5 selects different frames: must NOT hit total=6's
+    # entry (a false hit here would serve wrong-length features)
+    ex2 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "b", cache_dir,
+                                    extraction_total=5))
+    calls = []
+    real = ex2.extract
+    ex2.extract = lambda v: calls.append(v) or real(v)
+    assert ex2._extract(sample_video) is not None
+    assert calls == [sample_video]  # recomputed, not served
+
+
+def test_miss_on_weights_change(sample_video, tmp_path):
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    cache_dir = tmp_path / "cache"
+    ex1 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "a", cache_dir))
+    ex1._extract(sample_video)
+    fc1 = ex1.feature_cache()
+    # the same config over a re-converted / fine-tuned checkpoint: the
+    # capture carries a different sha, so the key must change
+    ex2 = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "b", cache_dir))
+    ex2._weights_capture = [{"model_key": "resnet18",
+                             "sha256": "f" * 64}]
+    fc2 = ex2.feature_cache()
+    assert fc2 is not None and fc2.weights_fp != fc1.weights_fp
+    assert fc2.key_for(sample_video) != fc1.key_for(sample_video)
+    assert fc2.lookup(sample_video, ex2.output_feat_keys) is None
+
+
+def test_resize_auto_shares_entries_with_resolved_value(
+        sample_video, tmp_path):
+    from video_features_tpu.extractors.resnet import ExtractResNet
+    cache_dir = tmp_path / "cache"
+    auto = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "a",
+                                     cache_dir, resize="auto"))
+    explicit = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "b",
+                                         cache_dir, resize="device"))
+    host = ExtractResNet(_resnet_cfg(sample_video, tmp_path / "c",
+                                     cache_dir, resize="host"))
+    assert auto.resize_mode == "device"  # save sink: auto -> device (PR 6)
+    fp_auto = auto.feature_cache().config_fp
+    assert fp_auto == explicit.feature_cache().config_fp
+    assert fp_auto != host.feature_cache().config_fp
+    # equivalence is end-to-end: auto's stored entry SERVES the explicit
+    # extractor byte-for-byte
+    feats = auto._extract(sample_video)
+    explicit.extract = lambda v: (_ for _ in ()).throw(
+        AssertionError("resize=device must hit resize=auto's entry"))
+    got = explicit._extract(sample_video)
+    for k in feats:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(feats[k]), err_msg=k)
+
+
+# -- two-pass CLI contract (the CI smoke's in-suite twin) -------------------
+
+def test_cli_two_pass_all_hits_bit_identical(sample_video, tmp_path):
+    import contextlib
+    import io as _io
+    import json
+    from video_features_tpu.cli import main as cli_main
+
+    vids = []
+    for i in range(2):
+        dst = tmp_path / f"v{i}.mp4"
+        shutil.copy(sample_video, dst)
+        vids.append(str(dst))
+    base = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_total=6", "batch_size=8", "telemetry=true",
+            "cache=true", f"cache_dir={tmp_path / 'cache'}",
+            f"tmp_path={tmp_path / 'tmp'}",
+            "video_paths=[" + ",".join(vids) + "]"]
+    with contextlib.redirect_stdout(_io.StringIO()):
+        cli_main(base + [f"output_path={tmp_path / 'p1'}"])
+        cli_main(base + [f"output_path={tmp_path / 'p2'}"])
+    p1 = sorted((tmp_path / "p1").rglob("*.npy"))
+    p2 = sorted((tmp_path / "p2").rglob("*.npy"))
+    assert [p.name for p in p1] == [p.name for p in p2] and len(p1) == 6
+    for a, b in zip(p1, p2):
+        assert a.read_bytes() == b.read_bytes(), a.name
+    # pass 2's final heartbeat: every lookup hit, nothing recomputed
+    hbs = list((tmp_path / "p2").rglob("_heartbeat_*.json"))
+    assert hbs, "telemetry=true must leave the heartbeat"
+    section = json.loads(hbs[0].read_text())["cache"]
+    assert section["hits"] == {"resnet": 2}
+    assert section["misses"] in ({}, {"resnet": 0})
+    assert section["hit_rate"] == 1.0

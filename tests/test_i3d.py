@@ -127,6 +127,7 @@ def test_flow_quantize_chain_matches_reference_transforms():
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
+@pytest.mark.slow  # ~50s; the rgb-only and flow-only E2Es below stay quick
 def test_end_to_end_two_stream_extraction(sample_video, tmp_path):
     from video_features_tpu.config import load_config, sanity_check
     from video_features_tpu.extractors.i3d import ExtractI3D
@@ -174,6 +175,7 @@ def test_end_to_end_flow_pwc_extraction(sample_video, tmp_path):
     assert (tmp_path / "out" / "i3d" / f"{Path(sample_video).stem}_flow.npy").exists()
 
 
+@pytest.mark.slow  # ~140s: the slowest quick-tier test by 3x; raft/io device-resize siblings keep the fused-resize path in the quick tier
 def test_i3d_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
     """resize=device (both streams: resize fused into rgb-I3D and the
     RAFT pair chain) must match the host-PIL path within the 2-LSB input

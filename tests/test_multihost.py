@@ -13,6 +13,7 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 from video_features_tpu.parallel.mesh import local_shard_of_list
 
@@ -103,6 +104,7 @@ def test_video_workers_threaded_pipeline_matches_serial(sample_video,
                                       err_msg=name)
 
 
+@pytest.mark.slow  # ~35s (subprocess + settle sleeps); worker-pool siblings stay quick
 def test_sigterm_graceful_preemption(sample_video, tmp_path):
     """Preemptible-worker contract (cli.py): on SIGTERM the worker finishes
     the in-flight video, drops the rest, prints the run summary, and exits

@@ -252,6 +252,7 @@ def test_cross_video_survives_corrupt_video(tmp_path):
     assert done == ["v0_r21d.npy", "v1_r21d.npy"], done
 
 
+@pytest.mark.slow  # ~54s E2E; the unit-level packer tests keep quick coverage
 def test_r21d_cross_video_outputs_identical(tmp_path):
     """E2E through the real extractor: cross_video_batching=true over
     several short videos (each well under one clip_batch_size group) must

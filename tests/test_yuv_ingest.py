@@ -83,7 +83,12 @@ OVERRIDES = ["resnet.model_name=resnet18", "resnet.batch_size=8",
              "r21d.stack_size=10", "r21d.step_size=10"]
 
 
-@pytest.mark.parametrize("workers", [1, 2])
+@pytest.mark.parametrize("workers", [
+    1,
+    # ~43s each: one worker count is enough for the quick tier; the
+    # threaded variant still runs in the full (slow-inclusive) suite
+    pytest.param(2, marks=pytest.mark.slow),
+])
 def test_yuv420_shared_decode_bit_identical_to_singles(tmp_path,
                                                        sample_video,
                                                        workers):

@@ -74,11 +74,24 @@ def _maybe_init_distributed(args) -> None:
                                   "gloo")
             except (AttributeError, ValueError):
                 pass  # older/newer jax without the knob: fine for TPU pods
-        # tolerate in-process re-runs; is_initialized is absent on older jax,
-        # where the double-init RuntimeError is caught instead
-        already = getattr(jax.distributed, "is_initialized", lambda: False)
+        # tolerate in-process re-runs AND launcher-preinitialized workers;
+        # is_initialized is absent on older jax, where the coordinator
+        # client on distributed.global_state is the ground truth (an older
+        # jax also raises a DIFFERENT message for a double init — "must be
+        # called before any JAX computations" — so the string probe on the
+        # RuntimeError alone is not a reliable detector)
+        def _already() -> bool:
+            fn = getattr(jax.distributed, "is_initialized", None)
+            if fn is not None:
+                return bool(fn())
+            try:
+                from jax._src.distributed import global_state
+                return global_state.client is not None \
+                    or global_state.coordinator_address is not None
+            except Exception:
+                return False
         try:
-            if not already():
+            if not _already():
                 jax.distributed.initialize()
         except RuntimeError as e:
             if "already" not in str(e).lower():
@@ -87,10 +100,17 @@ def _maybe_init_distributed(args) -> None:
 
 def main(argv: Optional[List[str]] = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # warm serving mode: `python main.py serve feature_type=...
+        # spool_dir=...` routes to the long-lived spool drainer
+        # (serve.py; also installed as the `vft-serve` console script)
+        from .serve import serve_main
+        return serve_main(argv[1:])
     cli_args = parse_dotlist(argv)
     if "feature_type" not in cli_args:
         raise SystemExit("Usage: main.py feature_type=<family>[,<family>...]"
-                         " [key=value ...]")
+                         " [key=value ...] | main.py serve feature_type=... "
+                         "spool_dir=<dir> (docs/serving.md)")
     from .registry import parse_feature_types
     families = parse_feature_types(cli_args.feature_type)
     multi_mode = len(families) > 1

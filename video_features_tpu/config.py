@@ -176,7 +176,8 @@ def load_multi_config(families: Sequence[str],
     return per
 
 
-def sanity_check_multi(per_family: "Dict[str, Config]") -> None:
+def sanity_check_multi(per_family: "Dict[str, Config]", *,
+                       require_videos: bool = True) -> None:
     """Multi-family constraints, then the normal per-family sanity_check
     (which namespaces each family's output/tmp paths under its own
     ``feature_type[/model_name]`` subdir — so sinks and journals never
@@ -199,7 +200,7 @@ def sanity_check_multi(per_family: "Dict[str, Config]") -> None:
                 "each family's reencode provenance is its own lossy "
                 "temp-file decode, which cannot share one pass — run "
                 "golden-parity extractions one family at a time")
-        sanity_check(args)
+        sanity_check(args, require_videos=require_videos)
 
 
 def resolve_device(device: Optional[str]) -> str:
@@ -226,8 +227,13 @@ def resolve_device(device: Optional[str]) -> str:
     return "tpu" if "tpu" in platforms else "cpu"
 
 
-def sanity_check(args: Config) -> None:
+def sanity_check(args: Config, *, require_videos: bool = True) -> None:
     """Validate user arguments and patch output/tmp paths in place.
+
+    ``require_videos=False`` (vft-serve, serve.py) skips the launch-time
+    video-list validation: a server has no corpus at launch — videos
+    arrive per request, and per-request failures route through the
+    normal per-video fault isolation instead of a launch assert.
 
     Reproduces the semantics of reference utils/utils.py:71-125:
       - one of video_paths / file_with_video_paths required
@@ -251,14 +257,15 @@ def sanity_check(args: Config) -> None:
         del args["device_ids"]
     args.device = resolve_device(args.get("device"))
 
-    assert args.get("file_with_video_paths") or args.get("video_paths"), \
-        "`video_paths` or `file_with_video_paths` must be specified"
-    filenames = [Path(p).stem for p in form_list_from_user_input(
-        args.get("video_paths"), args.get("file_with_video_paths"),
-        to_shuffle=False)]
-    assert len(filenames) == len(set(filenames)), \
-        "Non-unique video file stems: outputs would overwrite each other " \
-        "(same contract as reference video_features issue #54)"
+    if require_videos:
+        assert args.get("file_with_video_paths") or args.get("video_paths"), \
+            "`video_paths` or `file_with_video_paths` must be specified"
+        filenames = [Path(p).stem for p in form_list_from_user_input(
+            args.get("video_paths"), args.get("file_with_video_paths"),
+            to_shuffle=False)]
+        assert len(filenames) == len(set(filenames)), \
+            "Non-unique video file stems: outputs would overwrite each " \
+            "other (same contract as reference video_features issue #54)"
     assert os.path.relpath(str(args.output_path)) != os.path.relpath(str(args.tmp_path)), \
         "The same path for out & tmp"
 
@@ -326,6 +333,18 @@ def sanity_check(args: Config) -> None:
         raise ValueError(f"health={he!r}: expected true or false (digests "
                          "features into {output_path}/_health.jsonl and "
                          "quarantines NaN/Inf outputs, telemetry/health.py)")
+
+    # feature-cache keys (cache.py): validated at launch like the
+    # telemetry switches — a typo'd cache flag must not silently run cold
+    ca = args.get("cache", False)
+    if not isinstance(ca, bool):
+        raise ValueError(f"cache={ca!r}: expected true or false (the "
+                         "content-addressed feature cache, cache.py)")
+    cd = args.get("cache_dir")
+    if cd is not None and not isinstance(cd, str):
+        raise ValueError(f"cache_dir={cd!r}: expected a directory path or "
+                         "null (null -> VFT_CACHE_DIR or "
+                         "~/.cache/video_features_tpu/feature_cache)")
 
     # resize=auto|host|device (extractors/base.py _resolve_resize_mode):
     # 'auto' (the default) picks 'device' for save sinks and 'host' for

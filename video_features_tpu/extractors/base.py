@@ -41,6 +41,19 @@ class BaseExtractor:
         # to write NaN/Inf (routed through the faults taxonomy as POISON).
         # Off by default; the disabled cost is this one attribute read.
         self.health = bool(args.get("health", False))
+        # cache=true (cache.py): content-addressed feature cache keyed on
+        # (input sha256, resolved-config fingerprint, weights sha). The
+        # weights capture must start BEFORE the subclass __init__ resolves
+        # its params (weights/store.py resolve_params records what it
+        # loaded into this list); the FeatureCache handle itself is built
+        # lazily on first _extract, after every resolved attribute
+        # (resize_mode, ingest) exists.
+        self.cache_enabled = bool(args.get("cache", False))
+        if self.cache_enabled:
+            from ..weights import store as _wstore
+            self._weights_capture = _wstore.start_weights_capture()
+        self._cache = None
+        self._cache_built = False
         # video_decode=process: each video's decode+transform runs in a
         # spawned worker process (utils/io.py ProcessVideoSource) — lifts
         # the parent-GIL ceiling on numpy/PIL transform work on multi-core
@@ -211,12 +224,55 @@ class BaseExtractor:
         return ingest
 
     # -- lifecycle ---------------------------------------------------------
+    def feature_cache(self):
+        """This extractor's content-addressed cache handle (cache.py), or
+        None when ``cache=false``. Built once, lazily: the fingerprints
+        need the subclass's resolved attributes and weights capture."""
+        if not self._cache_built:
+            self._cache_built = True
+            if self.cache_enabled:
+                from ..cache import FeatureCache
+                self._cache = FeatureCache.for_extractor(self)
+        return self._cache
+
     def _extract(self, video_path: str) -> Optional[Dict[str, np.ndarray]]:
+        from .. import telemetry
+        # Precedence: cache hit > filename skip (docs/performance.md).
+        # The cache key proves the CONTENT + config + weights match; the
+        # filename skip only proves a file with the right name loads —
+        # so a hit re-serves through the sink path (which still skips the
+        # physical write when the files already exist), keeping outputs
+        # correct even when a stale same-stem file is present.
+        cache = self.feature_cache()
+        if cache is not None:
+            feats = cache.lookup(video_path, self.output_feat_keys)
+            if feats is not None:
+                telemetry.inc("vft_cache_hit_total",
+                              family=str(self.feature_type))
+                telemetry.annotate(cache="hit")
+                self.action_on_extraction(feats, video_path)
+                return feats
         if sinks.is_already_exist(self.on_extraction, self.output_path,
                                   video_path, self.output_feat_keys):
+            # work avoided WITHOUT consulting cache content: the same
+            # bypass counter fires whether cache=true (a miss that the
+            # filename contract absorbed) or cache=false, so
+            # telemetry_report can always show WHY work was avoided
+            telemetry.inc("vft_cache_bypass_total",
+                          family=str(self.feature_type))
+            telemetry.annotate(cache="bypass")
             return None
+        if cache is not None:
+            telemetry.inc("vft_cache_miss_total",
+                          family=str(self.feature_type))
+            telemetry.annotate(cache="miss")
         feats = self.extract(video_path)
         self.action_on_extraction(feats, video_path)
+        if cache is not None:
+            # store AFTER the sink path: the health gate (NaN/Inf ->
+            # POISON) and any sink failure must keep bad features out of
+            # the store exactly as they keep them off disk
+            cache.store(video_path, feats)
         return feats
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:

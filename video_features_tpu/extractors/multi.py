@@ -82,15 +82,23 @@ class MultiExtractor:
         pending: List[str] = []
         for f in self.families:
             ext = self.extractors[f]
+            # precedence note (docs/performance.md): this sweep is the
+            # FILENAME skip only — cache lookups happen inside each
+            # family's _extract, where a hit returns before the family
+            # ever subscribes to the bus (so an all-hit video still
+            # costs zero decode: every family marks done() without a
+            # subscription and the bus has no plan to walk)
             if sinks.is_already_exist(ext.on_extraction, ext.output_path,
                                       video_path, ext.output_feat_keys):
                 # up-front per-family skip: when every family lands here
                 # the video costs ZERO decode (no bus, no wav rip)
+                from .. import telemetry
+                telemetry.inc("vft_cache_bypass_total", family=str(f))
                 statuses[f] = "skipped"
                 if recorder is not None:
                     with recorder.video_span(video_path,
                                              feature_type=f) as span:
-                        span.annotate(status="skipped")
+                        span.annotate(status="skipped", cache="bypass")
             else:
                 pending.append(f)
         if not pending:

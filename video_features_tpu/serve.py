@@ -1,0 +1,543 @@
+"""``vft-serve``: a warm, long-lived extraction server over a file spool.
+
+The batch CLI treats every invocation as a cold job: import jax, compile
+(or at best re-load the persistent XLA cache), fault the params onto the
+device, drain a list, exit. At serving scale that cold tax dominates
+small requests — tens of seconds of compile against milliseconds of
+forward. ``vft-serve`` keeps ONE process alive with:
+
+  - the **compilation cache** enabled once (cli.py
+    ``_enable_compilation_cache``) and every executable warm after its
+    first use — request latency after request 1 contains no compile
+    (the run manifest's ``compile_cache`` hit/miss counters prove it);
+  - **params resident**: each family's extractor is constructed once,
+    its weights committed to device memory for the process lifetime
+    (the NamedSharding/commit discipline of parallel/mesh.py);
+  - **cross-request clip packing**: with ``cross_video_batching=true``
+    the extractor's one :class:`~.parallel.packer.ClipPacker` outlives
+    requests, so clips from concurrently-processed requests fill the
+    same fixed-shape device groups (the packer already packs across
+    *videos*; the server merely feeds it videos from more than one
+    request at a time) with the same poison-exact failure containment —
+    a failed group fails exactly its member videos, each reported in
+    its own request's response;
+  - the **content-addressed feature cache** (cache.py): with
+    ``cache=true`` repeat content short-circuits before any decoder is
+    built, which at fleet scale is the dominant request outcome.
+
+**Spool protocol** (filesystem-coordinated; no new daemon protocol —
+docs/serving.md has the full contract):
+
+  ======================  ==================================================
+  ``{spool}/requests/``   clients atomically rename request JSON in
+  ``{spool}/claimed/``    server claims by ``os.rename`` (atomic; a losing
+                          racer just sees ENOENT)
+  ``{spool}/done/``       one response JSON per request (atomic replace)
+  ``{spool}/_heartbeat_{host_id}.json``  liveness AND readiness: the
+                          normal telemetry heartbeat (run_id-stamped,
+                          PR 5 staleness semantics) plus a ``serve``
+                          section — state, queue depths, request tallies
+  ======================  ==================================================
+
+A request is ``{"id": ..., "video_paths": [...]}``; the response carries
+per-video statuses, artifact locations (the server's configured
+``output_path``), wait/latency seconds, and the request's compile-cache
+delta. **Admission control**: a backlog beyond ``serve_max_pending``
+rejects new requests immediately (an explicit ``rejected`` response —
+at saturation, fast refusal beats unbounded queueing), and claiming is
+throttled while the shared-decode fan-out gauges
+(``vft_fanout_queue_depth`` / ``put_blocked`` — PR 4) report
+backpressure, so admission follows the pipeline's own signals rather
+than a guess.
+
+Run it: ``vft-serve feature_type=resnet spool_dir=/srv/vft ...`` (or
+``python main.py serve ...``). All family config keys apply; the
+serve-specific keys are ``spool_dir`` (required), ``serve_workers``,
+``serve_max_pending``, ``serve_poll_interval_s``, ``serve_idle_exit_s``
+and ``serve_max_requests`` (the latter two bound a session — tests,
+benches, canaries). SIGTERM finishes in-flight work, writes a final
+heartbeat and exits 143 (the CLI's preemption contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+REQUESTS_DIR = "requests"
+CLAIMED_DIR = "claimed"
+DONE_DIR = "done"
+
+#: request/response schema identifiers
+REQUEST_SCHEMA = "vft.serve_request/1"
+RESPONSE_SCHEMA = "vft.serve_response/1"
+
+
+# -- client side -------------------------------------------------------------
+
+def spool_paths(spool_dir: str) -> Dict[str, str]:
+    root = str(spool_dir)
+    return {name: os.path.join(root, name)
+            for name in (REQUESTS_DIR, CLAIMED_DIR, DONE_DIR)}
+
+
+def ensure_spool(spool_dir: str) -> None:
+    for p in spool_paths(spool_dir).values():
+        os.makedirs(p, exist_ok=True)
+
+
+def submit_request(spool_dir: str, video_paths: List[str],
+                   request_id: Optional[str] = None) -> str:
+    """Drop one request into the spool (atomic: temp + rename INTO
+    ``requests/``, so the server can never claim a half-written file);
+    returns the request id."""
+    ensure_spool(spool_dir)
+    rid = request_id or uuid.uuid4().hex[:12]
+    req = {"schema": REQUEST_SCHEMA, "id": rid,
+           "video_paths": [str(v) for v in video_paths],
+           "time": round(time.time(), 3)}
+    final = os.path.join(spool_dir, REQUESTS_DIR, f"{rid}.json")
+    tmp = os.path.join(spool_dir, f".{rid}.json.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(req, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    return rid
+
+
+def read_response(spool_dir: str, request_id: str) -> Optional[dict]:
+    path = os.path.join(spool_dir, DONE_DIR, f"{request_id}.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def wait_response(spool_dir: str, request_id: str,
+                  timeout_s: float = 300.0,
+                  poll_s: float = 0.1) -> dict:
+    """Block until the response for ``request_id`` lands (or raise
+    TimeoutError). Polling a local/shared filesystem is the protocol —
+    clients need nothing but the spool mount."""
+    deadline = time.monotonic() + float(timeout_s)
+    while True:
+        resp = read_response(spool_dir, request_id)
+        if resp is not None:
+            return resp
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no response for request {request_id} within {timeout_s}s")
+        time.sleep(poll_s)
+
+
+def server_state(spool_dir: str) -> Dict[str, Any]:
+    """Client-side readiness probe: the freshest matching heartbeat's
+    ``serve`` section (+ liveness verdict), or ``{"state": "absent"}``.
+    Readiness == a fresh heartbeat whose serve state is ``ready``."""
+    import glob
+    from .telemetry.heartbeat import HEARTBEAT_GLOB, STALL_INTERVALS
+    best: Optional[dict] = None
+    for p in glob.glob(os.path.join(spool_dir, HEARTBEAT_GLOB)):
+        try:
+            with open(p, encoding="utf-8") as f:
+                hb = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if best is None or float(hb.get("time", 0)) > \
+                float(best.get("time", 0)):
+            best = hb
+    if best is None:
+        return {"state": "absent"}
+    age = max(0.0, time.time() - float(best.get("time", 0)))
+    interval = float(best.get("interval_s", 30.0)) or 30.0
+    serve = dict(best.get("serve") or {})
+    if best.get("final"):
+        serve["state"] = "exited"
+    elif age > STALL_INTERVALS * interval:
+        serve["state"] = "stalled"
+    serve.setdefault("state", "unknown")
+    serve["heartbeat_age_s"] = round(age, 3)
+    serve["run_id"] = best.get("run_id")
+    return serve
+
+
+# -- server side -------------------------------------------------------------
+
+class ServeLoop:
+    """The warm server: construct once, :meth:`run` until bounded out or
+    signalled. Separated from :func:`main` so tests/benches can drive it
+    in-process (a thread) with injected bounds."""
+
+    def __init__(self, args, per_family=None,
+                 out_root: Optional[str] = None) -> None:
+        self.args = args
+        self.per_family = per_family  # multi-family: {family: Config}
+        self.spool_dir = str(args.spool_dir)
+        self.paths = spool_paths(self.spool_dir)
+        ensure_spool(self.spool_dir)
+        self.poll_s = float(args.get("serve_poll_interval_s") or 0.25)
+        self.max_pending = int(args.get("serve_max_pending") or 64)
+        self.idle_exit_s = args.get("serve_idle_exit_s")
+        self.max_requests = args.get("serve_max_requests")
+        workers = args.get("serve_workers") or args.get("video_workers") or 1
+        if workers == "auto":
+            workers = max(1, min(8, (os.cpu_count() or 1) // 2))
+        self.workers = max(1, int(workers))
+        self._stop = threading.Event()
+        self._state = "warming"
+        self._state_lock = threading.Lock()
+        self._tallies = {"done": 0, "partial": 0, "failed": 0,
+                         "rejected": 0}
+        self._inflight = 0
+        self._request_latencies: List[float] = []
+
+        # -- warm construction: params resident for the process lifetime --
+        if per_family is not None:
+            from .extractors.multi import MultiExtractor
+            self.multi = MultiExtractor(per_family)
+            self.extractor = None
+        else:
+            from .registry import get_extractor_cls
+            from .utils.faults import FailureJournal, RetryPolicy
+            self.multi = None
+            self.extractor = get_extractor_cls(args.feature_type)(args)
+            self.policy = RetryPolicy.from_config(args)
+            self.journal = (FailureJournal(args.output_path)
+                            if args.get("on_extraction") != "print"
+                            else None)
+        self.out_root = str(out_root if out_root is not None
+                            else args.output_path)
+
+        # telemetry recorder is NOT optional in serve mode: its heartbeat
+        # in the SPOOL dir is the liveness/readiness protocol (clients
+        # read it with server_state); run telemetry still lands in the
+        # output dir via spans_path/manifest_path overrides below? No —
+        # one recorder, homed on the spool, is the single source of truth
+        import socket
+        from .config import _plain
+        from .telemetry.recorder import TelemetryRecorder
+        host_id = socket.gethostname()
+        try:
+            import jax
+            host_id = f"p{jax.process_index()}-{host_id}"
+        except Exception:
+            pass
+        families = (list(per_family) if per_family is not None
+                    else [args.feature_type])
+        self.families = families
+        run_config = (_plain(args) if per_family is None else
+                      {"feature_type": ",".join(families),
+                       "families": {f: _plain(a)
+                                    for f, a in per_family.items()}})
+        self.recorder = TelemetryRecorder(
+            self.spool_dir, run_config=run_config,
+            feature_type=",".join(families),
+            interval_s=float(args.get("metrics_interval_s") or 5.0),
+            host_id=host_id)
+        self.recorder.extra_sections["serve"] = self._serve_section
+
+    # -- heartbeat serve section ------------------------------------------
+    def _serve_section(self) -> dict:
+        with self._state_lock:
+            lat = list(self._request_latencies[-32:])
+            section = {
+                "state": self._state,
+                "pending": self._pending_count(),
+                "inflight": self._inflight,
+                "workers": self.workers,
+                "max_pending": self.max_pending,
+                "requests": dict(self._tallies),
+            }
+        if lat:
+            section["last_latency_s"] = round(lat[-1], 3)
+            section["mean_latency_s"] = round(sum(lat) / len(lat), 3)
+        return section
+
+    def _pending_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.paths[REQUESTS_DIR])
+                       if n.endswith(".json"))
+        except OSError:
+            return 0
+
+    def _set_state(self, state: str) -> None:
+        with self._state_lock:
+            self._state = state
+        # readiness must be visible promptly, not at the next interval
+        try:
+            self.recorder.write_heartbeat()
+        except Exception:
+            pass
+
+    # -- request processing ------------------------------------------------
+    def _respond(self, rid: str, payload: dict) -> None:
+        from .telemetry import jsonl
+        payload = {"schema": RESPONSE_SCHEMA, "id": rid,
+                   "time": round(time.time(), 3), **payload}
+        jsonl.write_json_atomic(
+            os.path.join(self.paths[DONE_DIR], f"{rid}.json"), payload)
+
+    def _run_one_video(self, video_path: str) -> Dict[str, str]:
+        """One video through the warm extractor(s); returns
+        {family: status} with safe_extract's vocabulary."""
+        from .utils.sinks import safe_extract
+        if self.multi is not None:
+            return self.multi.run_video(video_path, recorder=self.recorder)
+        with self.recorder.video_span(video_path) as span:
+            status = safe_extract(self.extractor._extract, video_path,
+                                  policy=self.policy, journal=self.journal,
+                                  decode_mode=self.extractor.video_decode)
+            span.annotate(status=status)
+        return {self.args.feature_type: status}
+
+    def _process(self, claimed_path: str) -> None:
+        from .telemetry import trace
+        rid = os.path.basename(claimed_path)[:-len(".json")]
+        t0 = time.perf_counter()
+        from .telemetry.recorder import _mon_snapshot, compile_cache_summary
+        mon_before = _mon_snapshot()
+        try:
+            with open(claimed_path, encoding="utf-8") as f:
+                req = json.load(f)
+            videos = [str(v) for v in req.get("video_paths") or []]
+        except (OSError, ValueError) as e:
+            self._respond(rid, {"status": "failed",
+                                "error": f"unreadable request: {e}"})
+            with self._state_lock:
+                self._tallies["failed"] += 1
+            os.unlink(claimed_path)
+            return
+        wait_s = max(0.0, time.time() - float(req.get("time") or time.time()))
+        statuses: Dict[str, Dict[str, str]] = {}
+        with trace.span("serve.request", id=rid, videos=len(videos)):
+            # videos of ONE request run on this request's worker thread
+            # sequentially; concurrency comes from multiple claimed
+            # requests in flight, which is exactly what packs their clips
+            # into shared device groups (parallel/packer.py)
+            for v in videos:
+                if self._stop.is_set():
+                    statuses[v] = {f: "dropped" for f in self.families}
+                    continue
+                try:
+                    statuses[v] = self._run_one_video(v)
+                except Exception as e:  # safe_extract contains per-video
+                    # failures; this guards the serve loop itself
+                    statuses[v] = {f: "error" for f in self.families}
+                    print(f"serve: request {rid} video {v} escaped: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+        flat = [s for per in statuses.values() for s in per.values()]
+        ok = all(s in ("done", "skipped") for s in flat) and flat
+        latency = time.perf_counter() - t0
+        self._respond(rid, {
+            "status": "done" if ok else "partial",
+            "videos": statuses,
+            "output_path": self.out_root,
+            "wait_s": round(wait_s, 3),
+            "latency_s": round(latency, 3),
+            # flat after request 1 == no recompilation (the acceptance
+            # signal; misses here mean a new (family, shape) executable)
+            "compile_cache": compile_cache_summary(mon_before),
+        })
+        with self._state_lock:
+            self._tallies["done" if ok else "partial"] += 1
+            self._request_latencies.append(latency)
+        try:
+            os.unlink(claimed_path)
+        except OSError:
+            pass
+
+    def _claim_next(self) -> Optional[str]:
+        """Claim the oldest pending request by atomic rename; None when
+        the spool is empty (or every candidate was raced away)."""
+        req_dir = self.paths[REQUESTS_DIR]
+        try:
+            names = [n for n in os.listdir(req_dir) if n.endswith(".json")]
+        except OSError:
+            return None
+        for name in sorted(
+                names,
+                key=lambda n: self._mtime(os.path.join(req_dir, n))):
+            src = os.path.join(req_dir, name)
+            dst = os.path.join(self.paths[CLAIMED_DIR], name)
+            try:
+                os.rename(src, dst)
+                return dst
+            except OSError:
+                continue  # another server (or a withdrawal) won the race
+        return None
+
+    @staticmethod
+    def _mtime(path: str) -> float:
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return float("inf")
+
+    def _reject_overflow(self) -> None:
+        """Admission control: beyond ``serve_max_pending`` queued
+        requests, refuse NEWEST arrivals immediately — a bounded queue
+        with a fast no is kinder to callers (they can retry elsewhere)
+        than an unbounded one that times them all out."""
+        req_dir = self.paths[REQUESTS_DIR]
+        try:
+            names = sorted(
+                (n for n in os.listdir(req_dir) if n.endswith(".json")),
+                key=lambda n: self._mtime(os.path.join(req_dir, n)))
+        except OSError:
+            return
+        for name in names[self.max_pending:][::-1]:
+            src = os.path.join(req_dir, name)
+            dst = os.path.join(self.paths[CLAIMED_DIR], name)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue
+            rid = name[:-len(".json")]
+            self._respond(rid, {
+                "status": "rejected",
+                "error": f"server backlog over serve_max_pending="
+                         f"{self.max_pending}; retry later"})
+            with self._state_lock:
+                self._tallies["rejected"] += 1
+            try:
+                os.unlink(dst)
+            except OSError:
+                pass
+
+    def _backpressured(self) -> bool:
+        """Defer claiming while the pipeline's own gauges say the decode
+        fan-out is saturated (PR 4's vft_fanout_queue_depth): admitting
+        more work would only grow in-process queues, not throughput."""
+        snap = self.recorder.fanout_snapshot()
+        depths = snap.get("queue_depth") or {}
+        if not depths:
+            return False
+        depth_cap = float(getattr(self, "_fanout_depth_cap", 0) or 0)
+        if depth_cap <= 0:
+            from .parallel import fanout
+            first = (next(iter(self.per_family.values()))
+                     if self.per_family else self.args)
+            self._fanout_depth_cap = depth_cap = float(
+                first.get("fanout_depth") or fanout.DEFAULT_DEPTH)
+        return max(depths.values()) >= depth_cap
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> int:
+        self.recorder.start()
+        self._set_state("ready")
+        print(f"vft-serve: ready — spool={self.spool_dir} "
+              f"families={','.join(self.families)} workers={self.workers} "
+              f"(heartbeat {self.recorder.heartbeat_path})")
+        from concurrent.futures import ThreadPoolExecutor
+        served = 0
+        idle_since = time.monotonic()
+        futures = set()
+        try:
+            with ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="vft-serve") as pool:
+                while not self._stop.is_set():
+                    futures = {f for f in futures if not f.done()}
+                    with self._state_lock:
+                        self._inflight = len(futures)
+                    self._reject_overflow()
+                    claimed = None
+                    if len(futures) < self.workers \
+                            and not self._backpressured():
+                        claimed = self._claim_next()
+                    if claimed is not None:
+                        served += 1
+                        idle_since = time.monotonic()
+                        futures.add(pool.submit(self._process, claimed))
+                        if self.max_requests is not None \
+                                and served >= int(self.max_requests):
+                            break
+                        continue  # drain the spool before sleeping
+                    if not futures:
+                        if self.idle_exit_s is not None and \
+                                time.monotonic() - idle_since \
+                                >= float(self.idle_exit_s):
+                            print("vft-serve: idle past "
+                                  f"serve_idle_exit_s={self.idle_exit_s} — "
+                                  "exiting")
+                            break
+                    self._stop.wait(self.poll_s)
+                # bounded exit or stop: wait for in-flight requests (their
+                # responses must land; atomic sinks make partial work safe)
+                self._set_state("draining")
+                for f in list(futures):
+                    f.result()
+        finally:
+            with self._state_lock:
+                self._inflight = 0
+                self._state = "exited"
+            self.recorder.close(tally=None, wall_s=None)
+        return 143 if self._stop.is_set() else 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def serve_main(argv: Optional[List[str]] = None) -> None:
+    """Entry point: ``vft-serve key=value ...`` (or
+    ``python main.py serve ...``)."""
+    from .config import (load_config, load_multi_config, parse_dotlist,
+                         sanity_check, sanity_check_multi)
+    from .registry import parse_feature_types
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cli_args = parse_dotlist(argv)
+    if "feature_type" not in cli_args or "spool_dir" not in cli_args:
+        raise SystemExit(
+            "Usage: vft-serve feature_type=<family>[,...] spool_dir=<dir> "
+            "[key=value ...]   (docs/serving.md)")
+    families = parse_feature_types(cli_args.feature_type)
+    # file sinks only: responses point at artifacts, and the idempotent
+    # skip + journals need per-family output dirs (print has neither)
+    if cli_args.get("on_extraction", "save_numpy") == "print":
+        raise SystemExit("vft-serve needs a file sink "
+                         "(on_extraction=save_numpy or save_pickle): "
+                         "responses reference artifact files")
+    cli_args.setdefault("on_extraction", "save_numpy")
+    from .cli import _enable_compilation_cache, _maybe_init_distributed
+    if len(families) > 1:
+        per_family = load_multi_config(families, cli_args)
+        args = per_family[families[0]]
+        # the user-level output root, captured BEFORE sanity_check
+        # namespaces each family's path beneath it (cli.py does the same)
+        out_root = str(args.output_path)
+        _maybe_init_distributed(args)
+        # no launch-time corpus: videos arrive per request
+        sanity_check_multi(per_family, require_videos=False)
+    else:
+        per_family = None
+        args = load_config(cli_args.feature_type, cli_args)
+        _maybe_init_distributed(args)
+        sanity_check(args, require_videos=False)
+        out_root = str(args.output_path)
+    _enable_compilation_cache(args)
+
+    loop = ServeLoop(args, per_family=per_family, out_root=out_root)
+    # SIGTERM/SIGINT: finish in-flight requests, final heartbeat, exit 143
+    if threading.current_thread() is threading.main_thread():
+        def _on_term(signo, frame):
+            print("vft-serve: SIGTERM — draining in-flight requests")
+            loop.stop()
+        signal.signal(signal.SIGTERM, _on_term)
+    rc = loop.run()
+    if rc:
+        raise SystemExit(rc)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    serve_main(argv)
+
+
+if __name__ == "__main__":
+    main()

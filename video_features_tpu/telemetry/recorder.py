@@ -138,6 +138,11 @@ class TelemetryRecorder:
         self._mon_baseline: Dict[str, int] = {}
         self._started = False
         self._closed = False
+        # extension hook: {section_name: zero-arg callable -> JSONable}.
+        # serve.py publishes its readiness/queue state through this — the
+        # heartbeat file IS the serve liveness protocol, so the recorder
+        # stays the single writer (one atomic replace per tick)
+        self.extra_sections: Dict[str, Callable[[], dict]] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "TelemetryRecorder":
@@ -245,7 +250,7 @@ class TelemetryRecorder:
         # scraper can turn into rates without double counting
         delta = {k: {"s": round(v[0], 6), "calls": v[1]}
                  for k, v in self._delta_stages.drain().items()}
-        return {
+        hb = {
             "schema": "vft.heartbeat/1",
             "run_id": self.run_id,
             "host": socket.gethostname(),
@@ -268,7 +273,39 @@ class TelemetryRecorder:
             # (its queue runs full, put_blocked grows) or the starved one
             # (its queue runs empty, get_starved grows) without the trace
             "fanout": self.fanout_snapshot(),
+            # feature-cache effectiveness (cache.py): per-family
+            # hit/miss/bypass totals + overall hit rate — the first-class
+            # bench number ISSUE 7 makes of repeat-content avoidance
+            "cache": self.cache_snapshot(),
         }
+        for name, fn in list(self.extra_sections.items()):
+            try:
+                hb[name] = fn()
+            except Exception:
+                hb[name] = {"error": "section callback failed"}
+        return hb
+
+    def cache_snapshot(self) -> dict:
+        """Per-family feature-cache counters pulled out of the registry:
+        ``{hits, misses, bypasses}`` each ``{family: n}``, plus the
+        overall ``hit_rate`` over consulted lookups (hits+misses; the
+        filename-skip bypasses avoided work without consulting cache
+        content, so they don't dilute the rate)."""
+        out: Dict[str, Dict[str, float]] = {
+            "hits": {}, "misses": {}, "bypasses": {}}
+        key_of = {"vft_cache_hit_total": "hits",
+                  "vft_cache_miss_total": "misses",
+                  "vft_cache_bypass_total": "bypasses"}
+        for s in self.registry.to_dict()["series"]:
+            key = key_of.get(s["name"])
+            fam = s.get("labels", {}).get("family")
+            if key is None or fam is None:
+                continue
+            out[key][fam] = int(s.get("value", 0))
+        hits = sum(out["hits"].values())
+        consulted = hits + sum(out["misses"].values())
+        out["hit_rate"] = round(hits / consulted, 4) if consulted else None
+        return out
 
     def fanout_snapshot(self) -> dict:
         """Per-family fan-out backpressure series pulled out of the

@@ -271,6 +271,41 @@ def weights_dir() -> Path:
         "VFT_WEIGHTS_DIR", os.path.expanduser("~/.cache/video_features_tpu")))
 
 
+# -- weights-identity capture (cache.py feature-cache keying) ----------------
+# resolve_params records WHAT it loaded (model key + file sha256, or the
+# random-init sentinel) into the thread's active capture list, installed by
+# BaseExtractor.__init__ right before the subclass resolves its params. The
+# feature cache folds the capture into its key, so a swapped/re-converted
+# checkpoint can never serve another checkpoint's cached features.
+
+import threading as _threading
+
+_capture_tls = _threading.local()
+
+
+def start_weights_capture() -> list:
+    """Begin a fresh capture on this thread; returns the (live) list that
+    subsequent ``resolve_params`` calls on this thread append to. Each
+    call replaces the active list, so sequentially-constructed extractors
+    (multi-family runs) each keep only their own resolutions."""
+    cap: list = []
+    _capture_tls.capture = cap
+    return cap
+
+
+def _record_resolution(rec: dict) -> None:
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.append(rec)
+
+
+def _file_fingerprint(path: Path) -> str:
+    """Streamed sha256 of the resolved checkpoint (memoized through
+    cache.file_sha256 so repeated constructions don't re-hash)."""
+    from ..cache import file_sha256
+    return file_sha256(str(path))
+
+
 def find_checkpoint(model_key: str,
                     explicit_path: Optional[str] = None) -> Optional[Path]:
     """Locate a weight file for ``model_key`` (msgpack preferred, else torch)."""
@@ -327,12 +362,22 @@ def resolve_params(model_key: str,
             print(f"WARNING: no weights found for {model_key!r}; using RANDOM "
                   "init (allow_random_weights=true). Features will be "
                   "meaningless — for tests/benchmarks only.")
+            # seeded init is deterministic: the sentinel keys cache entries
+            # for random-weight runs (tests/benches) without a file to hash
+            _record_resolution({"model_key": model_key, "random": True})
             return init_fn()
         raise FileNotFoundError(
             f"No weights for {model_key!r}. Provide `weights_path=...`, drop "
             f"a checkpoint into {weights_dir()}, or set "
             "`allow_random_weights=true` for throughput-only runs. Known "
             f"source filenames: {HUB_FILENAMES.get(model_key, '(model-specific)')}")
+    try:
+        _record_resolution({"model_key": model_key, "path": str(ckpt),
+                            "sha256": _file_fingerprint(ckpt)})
+    except OSError:
+        # capture is keying metadata, not a load requirement; an unreadable
+        # stat/hash surfaces as the load failure below if it matters
+        pass
     if ckpt.suffix == ".msgpack":
         return load_msgpack(init_fn(), ckpt)
     from .torch_import import load_torch_state_dict

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Fleet-queue quick-gate: 2 simulated hosts drain a 6-video queue with
+one injected straggler — every video extracted exactly once, and the
+``fleet=static`` default stays byte-identical to seed behavior (ISSUE 8).
+
+Sibling of the ``check_*_smoke.py`` gates, for the work-stealing fleet
+queue (parallel/queue.py). The contract IS the drain behavior, so the
+gate is dynamic end-to-end:
+
+  1. **static unchanged**: a run with no ``fleet`` key and a run with
+     explicit ``fleet=static`` must produce byte-identical artifacts —
+     the default path through cli.py is the pre-queue code path, and a
+     refactor that perturbed it fails here;
+  2. **queue drains exactly once**: two REAL ``fleet=queue`` CLI worker
+     processes share one output dir and drain the 6-video queue (one
+     video is an oversized straggler). Afterwards: one ``done`` marker
+     per video (the O_EXCL first-writer-wins contract), claim totals
+     across the two workers' final heartbeats sum to exactly 6 (no
+     double dispatch), every claim dir is empty, and the artifacts are
+     byte-identical to the static run's.
+
+Exit 0 = contract holds; exit 1 = every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml); the in-suite twins are
+tests/test_fleet.py (claim atomicity, lease expiry) and
+tests/test_chaos.py (worker kill + lease reclamation), and
+``python bench.py bench_fleet`` measures the makespan ratio.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+N_VIDEOS = 6
+TIMEOUT_S = 560
+
+BASE = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "allow_random_weights=true", "on_extraction=save_numpy",
+        "extraction_total=4", "batch_size=8", "video_workers=1"]
+
+_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from video_features_tpu.cli import main
+    main({argv!r})
+""")
+
+
+def _make_straggler(path: Path) -> bool:
+    """A ~2x-longer synthesized clip (conftest's moving-gradient recipe):
+    the one video static sharding can't see coming. Falls back to a plain
+    copy when cv2 can't encode (the exactly-once checks still hold)."""
+    try:
+        import cv2
+        import numpy as np
+        w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"),
+                            19.62, (320, 240))
+        if not w.isOpened():
+            return False
+        yy, xx = np.mgrid[0:240, 0:320].astype(np.float32)
+        for t in range(710):
+            frame = np.stack([
+                127 + 120 * np.sin(xx / 40 + t / 9),
+                127 + 120 * np.sin(yy / 30 - t / 13),
+                127 + 120 * np.sin((xx + yy) / 50 + t / 7),
+            ], axis=-1)
+            w.write(frame.clip(0, 255).astype(np.uint8))
+        w.release()
+        return path.exists() and path.stat().st_size > 0
+    except Exception:
+        return False
+
+
+def _npy_map(root: Path) -> dict:
+    return {p.relative_to(root): p.read_bytes()
+            for p in root.rglob("*.npy")}
+
+
+def check_fleet(td: Path) -> List[str]:
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    vids = []
+    for i in range(N_VIDEOS - 1):
+        dst = td / f"fleet{i}.mp4"
+        shutil.copy(SAMPLE, dst)
+        vids.append(str(dst))
+    straggler = td / "a-straggler.mp4"  # sorts first == claimed first
+    if not _make_straggler(straggler):
+        print("note: cv2 cannot encode — straggler is a plain copy")
+        shutil.copy(SAMPLE, straggler)
+    vids.insert(0, str(straggler))
+    listfile = td / "videos.txt"
+    listfile.write_text("\n".join(vids) + "\n")
+    corpus = BASE + [f"tmp_path={td / 'tmp'}",
+                     f"file_with_video_paths={listfile}"]
+
+    # ---- 1. fleet=static is byte-identical to the no-key default -------
+    with contextlib.redirect_stdout(io.StringIO()):
+        cli_main(corpus + [f"output_path={td / 'default'}"])
+        cli_main(corpus + [f"output_path={td / 'static'}", "fleet=static"])
+    default_npy = _npy_map(td / "default")
+    static_npy = _npy_map(td / "static")
+    n_feats = sum(1 for rel in default_npy
+                  if str(rel).endswith("_resnet.npy"))
+    if n_feats != N_VIDEOS:
+        errs.append(f"default run produced {n_feats}/{N_VIDEOS} "
+                    "feature artifacts")
+    if default_npy != static_npy:
+        errs.append("fleet=static output is NOT byte-identical to the "
+                    "no-fleet-key default — the static path drifted from "
+                    "seed behavior")
+
+    # ---- 2. two queue workers drain exactly once -----------------------
+    qargs = corpus + [f"output_path={td / 'queue'}", "fleet=queue",
+                      "fleet_lease_s=10", "telemetry=true",
+                      "metrics_interval_s=0.5"]
+    procs = []
+    for i in range(2):
+        log = open(td / f"worker{i}.log", "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, "-c",
+             _WORKER.format(repo=str(REPO_ROOT), argv=qargs)],
+            stdout=log, stderr=subprocess.STDOUT,
+            env=dict(os.environ, JAX_PLATFORMS="cpu")), log))
+    for i, (proc, log) in enumerate(procs):
+        rc = proc.wait(timeout=TIMEOUT_S)
+        log.close()
+        if rc != 0:
+            errs.append(f"queue worker {i} exited {rc}:\n"
+                        + (td / f"worker{i}.log").read_text()[-1500:])
+    if errs:
+        return errs
+
+    out = td / "queue" / "resnet" / "resnet18"
+    queue_npy = _npy_map(td / "queue")
+    if set(queue_npy) != set(static_npy):
+        errs.append(f"queue artifact set diverged: {len(queue_npy)} vs "
+                    f"{len(static_npy)} files")
+    for rel, data in static_npy.items():
+        if queue_npy.get(rel) != data:
+            errs.append(f"{rel}: queue bytes differ from the static run")
+    done = sorted((out / "_queue" / "done").glob("*.json"))
+    if len(done) != N_VIDEOS:
+        errs.append(f"{len(done)} done markers for {N_VIDEOS} videos "
+                    "(exactly-once violated)")
+    for p in done:
+        rec = json.loads(p.read_text())
+        if rec.get("status") not in ("done", "skipped"):
+            errs.append(f"done marker {p.name}: status={rec.get('status')}")
+    leftover = [str(p.relative_to(out)) for d in ("pending", "claimed")
+                for p in (out / "_queue" / d).rglob("*.json")]
+    if leftover:
+        errs.append(f"undrained queue entries left behind: {leftover}")
+    claimed = done_tally = 0
+    for hb_path in out.glob("_heartbeat_*.json"):
+        fl = json.loads(hb_path.read_text()).get("fleet") or {}
+        claimed += int(fl.get("claimed", 0))
+        done_tally += int(fl.get("done", 0))
+    if claimed != N_VIDEOS:
+        errs.append(f"claim tallies sum to {claimed}, want {N_VIDEOS} "
+                    "(double dispatch or lost item)")
+    if done_tally != N_VIDEOS:
+        errs.append(f"done tallies sum to {done_tally}, want {N_VIDEOS}")
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"SKIP: vendored sample missing ({SAMPLE})")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_fleet_smoke_") as td:
+        errs = check_fleet(Path(td))
+    if errs:
+        print("FLEET SMOKE: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"FLEET SMOKE: OK ({N_VIDEOS} videos incl. 1 straggler, 2 queue "
+          "workers, exactly-once drain, static path byte-identical)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,14 +101,41 @@ def render_manifest(man: dict) -> List[str]:
     return lines
 
 
+def _fleet_stragglers(hbs: List[dict], now: float) -> set:
+    """host_ids binding the fleet: a host still holding active claims
+    while the shared queue's pending is empty AND at least one other
+    live fleet host sits idle — everyone else is waiting on it (the
+    per-host idle tail ``fleet.idle_wait`` makes visible in traces)."""
+    live = []
+    for hb in hbs:
+        fl = hb.get("fleet")
+        if not isinstance(fl, dict) or hb.get("final"):
+            continue
+        interval = float(hb.get("interval_s", 30.0) or 30.0)
+        if now - float(hb.get("time", 0)) > STALL_INTERVALS * interval:
+            continue
+        live.append((str(hb.get("host_id")), fl))
+    if len(live) < 2:
+        return set()
+    idle = [h for h, fl in live if not fl.get("active_claims")]
+    if not idle:
+        return set()
+    return {h for h, fl in live
+            if fl.get("active_claims")
+            and not (fl.get("queue") or {}).get("pending", 0)}
+
+
 def render_heartbeats(paths: List[str], now: float,
                       run_id: Optional[str] = None,
                       started_time: Optional[float] = None) -> List[str]:
     lines = ["== heartbeats =="]
     if not paths:
         return lines + ["  (none)"]
+    loaded = {p: _load_json(p) for p in sorted(paths)}
+    stragglers = _fleet_stragglers(
+        [hb for hb in loaded.values() if hb is not None], now)
     for p in sorted(paths):
-        hb = _load_json(p)
+        hb = loaded[p]
         if hb is None:
             lines.append(f"  {os.path.basename(p)}: unreadable")
             continue
@@ -149,6 +176,29 @@ def render_heartbeats(paths: List[str], now: float,
             lines.append("    cache: " + ", ".join(
                 f"{k}={n}" for k, n in tallies)
                 + (f", hit_rate={rate}" if rate is not None else ""))
+        # fleet=queue scheduling state (parallel/queue.py): which host is
+        # doing/stealing the work, and — via the straggler flag — which
+        # one the rest of the fleet is idling behind, without opening a
+        # trace
+        fl = hb.get("fleet")
+        if isinstance(fl, dict):
+            q = fl.get("queue") or {}
+            line = ("    fleet: "
+                    f"claimed={fl.get('claimed', 0)} "
+                    f"done={fl.get('done', 0)} "
+                    f"stolen={fl.get('stolen', 0)} "
+                    f"reclaimed={fl.get('reclaimed', 0)} "
+                    f"active={fl.get('active_claims', 0)} "
+                    f"(oldest {fl.get('oldest_active_claim_age_s', 0):.0f}s)"
+                    f"  queue: pending={q.get('pending', 0)}/"
+                    f"claimed={q.get('claimed', 0)}/done={q.get('done', 0)}"
+                    + (f"/quarantined={q['quarantined']}"
+                       if q.get("quarantined") else "")
+                    + (f"  canary={fl['canary']}"
+                       if fl.get("canary") not in (None, "off") else ""))
+            if str(hb.get("host_id")) in stragglers:
+                line += "  STRAGGLER (fleet idle behind this host)"
+            lines.append(line)
     return lines
 
 

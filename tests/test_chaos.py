@@ -214,3 +214,136 @@ def test_chaos_distributed_preempt_corrupt_resume(sample_video, tmp_path):
     # the quarantine skip appended nothing: still exactly one record
     recs = journal_records()
     assert len(recs) == 1 and recs[0]["category"] == "POISON", recs
+
+
+# ---------------------------------------------------------------------------
+# Scheduling chaos (ISSUE 8): the fleet queue promoted from survival to
+# scheduling — a killed worker's LEASE is reclaimed and its video finishes
+# elsewhere, exactly once, bit-identically.
+# ---------------------------------------------------------------------------
+
+_QUEUE_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from video_features_tpu.cli import main
+    main([
+        "feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "allow_random_weights=true", "on_extraction=save_numpy",
+        "extraction_total=6", "batch_size=8", "video_workers=1",
+        "telemetry=true", "metrics_interval_s=0.5",
+        "fleet=queue", "fleet_lease_s=2",
+        "output_path={out}", "tmp_path={tmp}",
+        "file_with_video_paths={listfile}",
+    ])
+    print("QUEUE_WORKER_DONE")
+""")
+
+
+@pytest.mark.slow
+def test_chaos_queue_worker_kill_lease_reclaim(sample_video, tmp_path):
+    """Two fleet=queue workers share an output dir; the first worker to
+    claim a video is SIGKILLed mid-claim (no SIGTERM grace, no final
+    heartbeat). The survivor must: notice the dead worker's heartbeat
+    going stale, reclaim its expired lease, re-extract the video exactly
+    once, and drain the whole queue — with every artifact bit-identical
+    to an unkilled single-host run (parallel/queue.py; docs/fleet.md
+    failure matrix row 'worker SIGKILLed mid-video')."""
+    repo = str(Path(__file__).resolve().parent.parent)
+    n_videos = 4
+    videos = []
+    for i in range(n_videos):
+        dst = tmp_path / f"v_fleet_{i:02d}.mp4"
+        dst.write_bytes(Path(sample_video).read_bytes())
+        videos.append(str(dst))
+    listfile = tmp_path / "videos.txt"
+    listfile.write_text("\n".join(videos) + "\n")
+    out = tmp_path / "out"
+    feat_dir = out / "resnet" / "resnet18"
+    claimed_root = feat_dir / "_queue" / "claimed"
+
+    def spawn(idx):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        log = open(tmp_path / f"qworker_{idx}.log", "w")
+        script = _QUEUE_WORKER.format(
+            repo=repo, out=out, tmp=f"{tmp_path}/tmp_{idx}",
+            listfile=listfile)
+        return subprocess.Popen([sys.executable, "-c", script], stdout=log,
+                                stderr=subprocess.STDOUT, env=env), log
+
+    procs, logs = zip(*(spawn(i) for i in range(2)))
+    victim = survivor = None
+    try:
+        # ---- kill the first worker observed holding a claim ------------
+        deadline = time.time() + TIMEOUT_S
+        claim = None
+        while time.time() < deadline:
+            claims = list(claimed_root.glob("*/*.json"))
+            if claims:
+                claim = claims[0]
+                break
+            if all(p.poll() is not None for p in procs):
+                raise AssertionError(
+                    "both workers exited before claiming:\n" + "".join(
+                        (tmp_path / f"qworker_{i}.log").read_text()[-1000:]
+                        for i in range(2)))
+            time.sleep(0.01)
+        assert claim is not None, "no claim appeared before deadline"
+        owner_dir = claim.parent.name  # host id embeds the worker's pid
+        victim = next(i for i, p in enumerate(procs)
+                      if f"-{p.pid}-" in owner_dir)
+        survivor = 1 - victim
+        procs[victim].kill()  # SIGKILL: no drain, no final heartbeat
+        assert procs[victim].wait(timeout=30) == -signal.SIGKILL
+        # ---- the survivor reclaims and drains the fleet ----------------
+        assert procs[survivor].wait(timeout=TIMEOUT_S) == 0, \
+            (tmp_path / f"qworker_{survivor}.log").read_text()[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    surv_log = (tmp_path / f"qworker_{survivor}.log").read_text()
+    assert "QUEUE_WORKER_DONE" in surv_log, surv_log[-1500:]
+
+    # exactly-once: one done marker per video (O_EXCL first-writer-wins),
+    # nothing left pending/claimed, and the killed worker's item carries
+    # the reclaim provenance — finished by the survivor after >= 1 steal
+    done_dir = feat_dir / "_queue" / "done"
+    done = {p.stem: json.loads(p.read_text())
+            for p in done_dir.glob("*.json")}
+    assert len(done) == n_videos, sorted(done)
+    assert not list((feat_dir / "_queue" / "pending").glob("*.json"))
+    assert not list(claimed_root.glob("*/*.json"))
+    reclaimed = [r for r in done.values() if r["reclaims"] >= 1]
+    assert reclaimed, "the killed worker's lease was never reclaimed"
+    for rec in reclaimed:
+        assert f"-{procs[victim].pid}-" not in rec["by"], \
+            "a dead worker cannot complete work"
+        assert rec["status"] in ("done", "skipped"), rec
+    for rec in done.values():
+        assert rec["status"] in ("done", "skipped"), rec
+
+    # bit-identical to an unkilled run: same artifact set, same bytes
+    from video_features_tpu.cli import main as cli_main
+    ref = tmp_path / "ref"
+    cli_main([
+        "feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "allow_random_weights=true", "on_extraction=save_numpy",
+        "extraction_total=6", "batch_size=8", "video_workers=1",
+        f"output_path={ref}", f"tmp_path={tmp_path}/tmp_ref",
+        f"file_with_video_paths={listfile}",
+    ])
+    ref_npy = {p.relative_to(ref): p.read_bytes()
+               for p in ref.rglob("*.npy")}
+    queue_npy = {p.relative_to(out): p.read_bytes()
+                 for p in out.rglob("*.npy")}
+    assert set(ref_npy) == set(queue_npy), "artifact sets diverged"
+    assert len({rel for rel in ref_npy
+                if str(rel).endswith("_resnet.npy")}) == n_videos
+    for rel, data in ref_npy.items():
+        assert queue_npy[rel] == data, \
+            f"{rel}: killed-and-reclaimed run diverged from clean run"

@@ -208,3 +208,32 @@ def test_corr_lookup_config_promotion(monkeypatch, tmp_path):
                                           "corr_lookup_impl": "bogus"}))
     with pytest.raises(ValueError):
         sanity_check(load_config("raft", {**base, "fuse_convc1": "yes"}))
+
+
+def test_fleet_key_validation(tmp_path):
+    """fleet= scheduling keys (parallel/queue.py): a typo'd mode or a
+    queue run missing its prerequisites must fail at launch, before N
+    hosts start claiming (ISSUE 8)."""
+    base = dict(video_paths="a.mp4", output_path=str(tmp_path / "o"),
+                tmp_path=str(tmp_path / "t"))
+    sanity_check(load_config("resnet", {**base, "fleet": "static"}))
+    # queue mode needs telemetry (lease renewal) + a file sink
+    sanity_check(load_config("resnet", {
+        **base, "fleet": "queue", "telemetry": True,
+        "on_extraction": "save_numpy"}))
+    with pytest.raises(ValueError, match="fleet="):
+        sanity_check(load_config("resnet", {**base, "fleet": "dynamic"}))
+    with pytest.raises(ValueError, match="telemetry"):
+        sanity_check(load_config("resnet", {
+            **base, "fleet": "queue", "on_extraction": "save_numpy"}))
+    with pytest.raises(ValueError, match="file sink"):
+        sanity_check(load_config("resnet", {
+            **base, "fleet": "queue", "telemetry": True}))
+    with pytest.raises(ValueError, match="fleet_lease_s"):
+        sanity_check(load_config("resnet", {**base, "fleet_lease_s": 0}))
+    with pytest.raises(ValueError, match="fleet_max_reclaims"):
+        sanity_check(load_config("resnet",
+                                 {**base, "fleet_max_reclaims": 0}))
+    with pytest.raises(ValueError, match="fleet_canary"):
+        sanity_check(load_config("resnet",
+                                 {**base, "fleet_canary": "yes"}))

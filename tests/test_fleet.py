@@ -1,0 +1,410 @@
+"""Work-stealing fleet queue (parallel/queue.py, ISSUE 8): claim
+atomicity under concurrent claimants, lease expiry + stealing, heartbeat
+-driven reclamation, quarantine-after-N-reclaims, exactly-once completion
+markers, and the telemetry_report fleet/straggler rendering.
+
+Everything here is filesystem-state unit testing with an injected clock —
+no sleeps, no subprocesses. The end-to-end twins are
+scripts/check_fleet_smoke.py (real CLI workers) and tests/test_chaos.py
+(worker kill + lease reclamation); bench.py bench_fleet measures the
+makespan ratio the queue exists to win.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu.parallel import queue as fq
+from video_features_tpu.telemetry.jsonl import write_json_atomic
+
+pytestmark = pytest.mark.quick
+
+
+class Clock:
+    """Injectable time source: tests advance leases, never sleep."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _hb(root, host, *, now, age_s=0.0, interval_s=1.0, final=False):
+    write_json_atomic(
+        os.path.join(root, f"_heartbeat_{host}.json"),
+        {"host_id": host, "time": now - age_s, "interval_s": interval_s,
+         "final": final})
+
+
+def _wq(root, host, clk, **kw):
+    kw.setdefault("lease_s", 5.0)
+    return fq.WorkQueue(str(root), host_id=host, run_id=f"run-{host}",
+                        clock=clk, **kw)
+
+
+def test_seed_idempotent_and_concurrent(tmp_path):
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    videos = [f"/data/v{i:02d}.mp4" for i in range(10)]
+    assert a.seed(videos) == 10
+    assert b.seed(videos) == 0  # every item already pending
+    assert a.counts() == {"pending": 10, "claimed": 0, "done": 0,
+                          "quarantined": 0}
+
+
+def test_claim_atomicity_concurrent_claimants(tmp_path):
+    """4 hosts x 2 threads hammer claim_next on one shared queue: no item
+    claimed twice, no item lost — the os.rename claim is the lock."""
+    clk = Clock()
+    videos = [f"/data/v{i:03d}.mp4" for i in range(40)]
+    hosts = [_wq(tmp_path, f"h{i}", clk) for i in range(4)]
+    hosts[0].seed(videos)
+    claimed, lock = [], threading.Lock()
+
+    def worker(q):
+        while True:
+            rec = q.claim_next()
+            if rec is None:
+                return
+            with lock:
+                claimed.append(rec["video"])
+
+    threads = [threading.Thread(target=worker, args=(q,))
+               for q in hosts for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(claimed) == sorted(videos)  # exactly once each
+    assert len(set(claimed)) == len(videos)
+    c = hosts[0].counts()
+    assert c["pending"] == 0 and c["claimed"] == len(videos)
+    assert sum(q._tallies["claimed"] for q in hosts) == len(videos)
+
+
+def test_lease_expiry_is_stolen_and_tallied(tmp_path):
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    # a live heartbeat with a long interval: only the LEASE decides here
+    _hb(tmp_path, "A", now=clk.t, interval_s=60.0)
+    a.seed(["/data/slow.mp4"])
+    rec = a.claim_next()
+    assert rec["deadline"] == pytest.approx(clk.t + 5.0)
+    assert b.reclaim_expired() == 0  # lease still live
+    clk.t += 6.0  # A stalled past its lease without renewing
+    assert b.reclaim_expired() == 1
+    stolen = b.claim_next()
+    assert stolen["video"] == "/data/slow.mp4"
+    assert stolen["reclaims"] == 1 and stolen["last_owner"] == "A"
+    assert b._tallies["stolen"] == 1 and b._tallies["reclaimed"] == 1
+
+
+def test_live_renewal_prevents_stealing(tmp_path):
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    _hb(tmp_path, "A", now=clk.t)
+    a.seed(["/data/v.mp4"])
+    a.claim_next()
+    for _ in range(4):  # heartbeat ticks keep pushing the deadline
+        clk.t += 3.0
+        _hb(tmp_path, "A", now=clk.t)
+        a.renew_leases()
+        assert b.reclaim_expired() == 0
+    assert a.counts()["claimed"] == 1
+
+
+def test_stale_heartbeat_releases_unexpired_lease(tmp_path):
+    """A SIGKILLed host stops renewing AND beating: siblings must not
+    wait out a long lease when the heartbeat already proves death."""
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk, lease_s=10_000.0)
+    b = _wq(tmp_path, "B", clk, lease_s=10_000.0)
+    a.seed(["/data/v.mp4"])
+    a.claim_next()
+    _hb(tmp_path, "A", now=clk.t, interval_s=1.0)
+    assert b.reclaim_expired() == 0  # fresh heartbeat: A is alive
+    clk.t += 10.0  # > STALL_INTERVALS * interval_s, lease NOT expired
+    assert b.reclaim_expired() == 1
+    assert b.claim_next()["last_owner"] == "A"
+
+
+def test_final_heartbeat_releases_claims(tmp_path):
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk, lease_s=10_000.0)
+    b = _wq(tmp_path, "B", clk, lease_s=10_000.0)
+    a.seed(["/data/v.mp4"])
+    a.claim_next()
+    _hb(tmp_path, "A", now=clk.t, final=True)  # clean exit, claim leaked
+    assert b.reclaim_expired() == 1
+
+
+def test_quarantine_after_max_reclaims(tmp_path):
+    """An item that keeps outliving its workers is pathological: after
+    max_reclaims lease reclaims it routes to quarantined/ + the failure
+    journal as POISON instead of being re-dispatched forever."""
+    class Journal:
+        records = []
+
+        def record(self, video, category, attempts, error, elapsed_s):
+            self.records.append(
+                dict(video=video, category=category, attempts=attempts,
+                     error=error))
+
+    clk = Clock()
+    j = Journal()
+    a = _wq(tmp_path, "A", clk, max_reclaims=2, journal=j)
+    b = _wq(tmp_path, "B", clk, max_reclaims=2, journal=j)
+    a.seed(["/data/poison.mp4"])
+    # reclaim 1 and 2 re-dispatch; reclaim 3 (> max_reclaims=2) quarantines
+    for stealer, victim in ((b, a), (a, b), (b, a)):
+        victim.claim_next()
+        clk.t += 6.0
+        stealer.reclaim_expired()
+    c = a.counts()
+    assert c == {"pending": 0, "claimed": 0, "done": 0, "quarantined": 1}
+    q = json.loads(
+        (tmp_path / "_queue" / "quarantined" / os.listdir(
+            tmp_path / "_queue" / "quarantined")[0]).read_text())
+    assert q["reclaims"] == 3
+    assert len(j.records) == 1
+    assert j.records[0]["category"] == "POISON"
+    assert j.records[0]["video"] == "/data/poison.mp4"
+    assert "fleet_max_reclaims" in j.records[0]["error"]
+
+
+def test_complete_first_writer_wins(tmp_path):
+    """Reclaim race: two hosts legitimately end up extracting the same
+    item (idempotent sinks make that safe); exactly one done marker
+    exists and the loser books lease_lost, not done."""
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    a.seed(["/data/v.mp4"])
+    rec_a = a.claim_next()
+    clk.t += 6.0
+    b.reclaim_expired()
+    rec_b = b.claim_next()
+    assert b.complete(rec_b, "done") is True
+    assert a.complete(rec_a, "done") is False  # marker already exists
+    done = list((tmp_path / "_queue" / "done").glob("*.json"))
+    assert len(done) == 1
+    assert json.loads(done[0].read_text())["by"] == "B"
+    assert a._tallies["lease_lost"] == 1 and b._tallies["done"] == 1
+    assert a.all_done() and b.all_done()
+
+
+def test_done_item_never_reclaimed_or_reseeded(tmp_path):
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    a.seed(["/data/v.mp4"])
+    a.complete(a.claim_next(), "done")
+    assert b.seed(["/data/v.mp4"]) == 0  # done marker is ground truth
+    # a raced re-seed (torn reclaimer) is discarded at claim time
+    iid = fq.item_id("/data/v.mp4")
+    (tmp_path / "_queue" / "pending" / f"{iid}.json").write_text(
+        json.dumps({"schema": fq.ITEM_SCHEMA, "id": iid,
+                    "video": "/data/v.mp4", "reclaims": 0}))
+    assert b.claim_next() is None
+    assert b._tallies["duplicate_discarded"] == 1
+    assert b.all_done()
+
+
+def test_release_returns_item_unbumped(tmp_path):
+    clk = Clock()
+    a, b = _wq(tmp_path, "A", clk), _wq(tmp_path, "B", clk)
+    a.seed(["/data/v.mp4"])
+    rec = a.claim_next()
+    a.release(rec)  # graceful hand-back (SIGTERM drain): not a pathology
+    assert a.counts()["pending"] == 1
+    again = b.claim_next()
+    assert again["reclaims"] == 0
+    assert b._tallies["stolen"] == 0  # released, not stolen
+
+
+def test_staging_orphan_recovered(tmp_path):
+    """A stealer that died between the staging rename and the pending
+    write must not lose the item: old staging entries are swept back."""
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk, lease_s=5.0)
+    staging = tmp_path / "_queue" / ".staging" / "dead.it-1234.json"
+    staging.write_text(json.dumps(
+        {"schema": fq.ITEM_SCHEMA, "id": "it-1234",
+         "video": "/data/v.mp4", "reclaims": 1}))
+    os.utime(staging, (clk.t - 30.0, clk.t - 30.0))  # > 4 lease periods
+    assert a.reclaim_expired() == 1
+    rec = a.claim_next()
+    assert rec["id"] == "it-1234" and rec["reclaims"] == 1
+
+
+def test_drain_exactly_once_across_hosts(tmp_path):
+    # real wall clock here: drain idle-waits on a real threading.Event
+    videos = [f"/data/v{i:02d}.mp4" for i in range(12)]
+    hosts = [fq.WorkQueue(str(tmp_path), host_id=f"h{i}", lease_s=60.0)
+             for i in range(3)]
+    for i in range(3):
+        _hb(tmp_path, f"h{i}", now=time.time())
+    for h in hosts:
+        h.seed(videos)
+    ran, lock = [], threading.Lock()
+
+    def run_fn(video):
+        with lock:
+            ran.append(video)
+        return "done"
+
+    threads = [threading.Thread(
+        target=lambda h=h: h.drain(run_fn, workers=2, poll_s=0.02))
+        for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(ran) == sorted(videos)  # every video exactly once
+    assert hosts[0].all_done()
+    done = list((tmp_path / "_queue" / "done").glob("*.json"))
+    assert len(done) == len(videos)
+    sections = [h.heartbeat_section() for h in hosts]
+    assert sum(s["claimed"] for s in sections) == len(videos)
+    assert all(s["mode"] == "queue" for s in sections)
+
+
+def test_heartbeat_section_renews_leases(tmp_path):
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk)
+    a.seed(["/data/v.mp4"])
+    rec = a.claim_next()
+    first_deadline = rec["deadline"]
+    clk.t += 3.0
+    section = a.heartbeat_section()  # the heartbeat tick IS the renewal
+    assert section["active_claims"] == 1
+    assert section["oldest_active_claim_age_s"] == pytest.approx(3.0)
+    stamped = json.loads(Path(a._claim_path(rec["id"])).read_text())
+    assert stamped["deadline"] == pytest.approx(first_deadline + 3.0)
+
+
+def test_canary_founding_member_passes(tmp_path):
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk)
+    ok, lines = a.canary_gate(lambda v, d: ("done", 0.1))
+    assert ok and "founding member" in lines[0]
+    assert a.heartbeat_section()["canary"] == "founding"
+
+
+def test_telemetry_report_fleet_line_and_straggler(tmp_path):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "scripts"))
+    import telemetry_report
+    now = time.time()
+    fleet_a = {"mode": "queue", "active_claims": 1, "claimed": 5,
+               "done": 4, "stolen": 1, "reclaimed": 1, "requeued": 1,
+               "oldest_active_claim_age_s": 42.0,
+               "queue": {"pending": 0, "claimed": 1, "done": 10},
+               "canary": "off"}
+    fleet_b = dict(fleet_a, active_claims=0, claimed=6, done=6, stolen=0,
+                   oldest_active_claim_age_s=0.0)
+    for host, fl in (("hostA", fleet_a), ("hostB", fleet_b)):
+        write_json_atomic(
+            tmp_path / f"_heartbeat_{host}.json",
+            {"host_id": host, "time": now, "interval_s": 30.0,
+             "final": False, "videos_done": fl["done"], "fleet": fl})
+    paths = [str(p) for p in tmp_path.glob("_heartbeat_*.json")]
+    out = "\n".join(telemetry_report.render_heartbeats(paths, now))
+    assert "fleet: claimed=5 done=4 stolen=1" in out
+    assert "STRAGGLER" in out
+    a_line = next(l for l in out.splitlines() if "claimed=5" in l)
+    b_line = next(l for l in out.splitlines() if "claimed=6" in l)
+    assert "STRAGGLER" in a_line and "STRAGGLER" not in b_line
+
+
+# ---------------------------------------------------------------------------
+# Canary gating: a joining host re-extracts a slice of done work and must
+# pass compare_runs digest bands + bench_history timing bands first.
+# ---------------------------------------------------------------------------
+
+def _health_rec(video, *, mean=0.5, sig="sigA"):
+    return {"schema": "vft.feature_health/1", "video": str(video),
+            "feature_type": "resnet", "key": "resnet",
+            "shape": [4, 512], "dtype": "float32", "elems": 2048,
+            "nan": 0, "inf": 0, "min": 0.0, "max": 1.0, "mean": mean,
+            "std": 0.1, "l2": 10.0, "sig": sig, "time": 1.0}
+
+
+def test_canary_gate_digest_and_timing_bands(tmp_path):
+    from video_features_tpu.telemetry.jsonl import append_jsonl
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk)
+    vids = []
+    for i in range(2):
+        v = tmp_path / f"v{i}.mp4"
+        v.write_bytes(b"x")  # canary samples only EXISTING videos
+        vids.append(str(v))
+    a.seed(vids)
+    for _ in range(2):
+        a.complete(a.claim_next(), "done", elapsed_s=2.0)
+    for v in vids:
+        append_jsonl(tmp_path / "_health.jsonl", _health_rec(v))
+
+    def extract(mean=0.5, sig="sigA", elapsed=1.5):
+        def fn(video, out_dir):
+            append_jsonl(Path(out_dir) / "_health.jsonl",
+                         _health_rec(video, mean=mean, sig=sig))
+            return "done", elapsed
+        return fn
+
+    ok, lines = _wq(tmp_path, "B", clk).canary_gate(extract())
+    assert ok, lines
+    assert any("PASS" in l for l in lines)
+
+    # numeric drift past the stock atol=1e-2 band: gated out
+    ok, lines = _wq(tmp_path, "C", clk).canary_gate(
+        extract(mean=0.9, sig="sigZ"))
+    assert not ok
+    assert any("DIGEST DRIFT" in l for l in lines), lines
+
+    # 15x slower than the fleet's 2.0s median: outside the 2x band
+    ok, lines = _wq(tmp_path, "D", clk).canary_gate(extract(elapsed=30.0))
+    assert not ok
+    assert any("timing band" in l and "FAIL" in l for l in lines), lines
+
+    verdicts = [json.loads(p.read_text()) for p in
+                (tmp_path / "_queue" / "canary").glob("*.json")]
+    assert sorted(v["ok"] for v in verdicts) == [False, False, True]
+
+
+def test_cli_canary_join_passes_end_to_end(sample_video, tmp_path, capsys):
+    """Worker 1 drains a 2-video queue with health digests; worker 2
+    joins the finished run with fleet_canary=true — it must re-extract
+    the done slice, pass both bands against the fleet's digests, write
+    its verdict, and exit with nothing left to claim."""
+    import shutil
+
+    from video_features_tpu.cli import main as cli_main
+    vids = []
+    for i in range(2):
+        dst = tmp_path / f"v_canary_{i}.mp4"
+        shutil.copy(sample_video, dst)
+        vids.append(str(dst))
+    args = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_total=4", "batch_size=8", "video_workers=1",
+            "telemetry=true", "health=true", "metrics_interval_s=0.5",
+            "fleet=queue", "fleet_lease_s=10",
+            f"output_path={tmp_path / 'out'}",
+            f"tmp_path={tmp_path / 'tmp'}",
+            "video_paths=[" + ",".join(vids) + "]"]
+    cli_main(args)
+    capsys.readouterr()
+    cli_main(args + ["fleet_canary=true"])
+    out = capsys.readouterr().out
+    assert "fleet canary" in out
+    assert "0 extracted" in out  # the queue was already drained
+    qdir = tmp_path / "out" / "resnet" / "resnet18" / "_queue"
+    verdicts = [json.loads(p.read_text())
+                for p in (qdir / "canary").glob("*.json")]
+    assert len(verdicts) == 1 and verdicts[0]["ok"] is True, verdicts
+    assert len(verdicts[0]["videos"]) == 2
+    assert len(list((qdir / "done").glob("*.json"))) == 2

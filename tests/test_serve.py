@@ -110,3 +110,82 @@ def test_admission_control_rejects_overflow(sample_video, tmp_path):
     assert set(rejected) == set(rids[2:])
     for resp in (serve.read_response(spool, r) for r in rejected):
         assert "serve_max_pending" in resp["error"]
+
+
+def test_dead_server_claims_reclaimed(sample_video, tmp_path):
+    """A server that crashes mid-request must not strand its spool claims
+    (ISSUE 8 satellite): once its heartbeat is stale, a live sibling's
+    sweep renames the claims back into requests/ — except claims whose
+    response already landed, which are dropped, and claims owned by a
+    server whose heartbeat is still fresh, which are left alone. A flat
+    legacy claim (no owner dir) is reclaimed unconditionally."""
+    import os
+    import time
+
+    from video_features_tpu.config import (load_config, parse_dotlist,
+                                           sanity_check)
+    from video_features_tpu.telemetry.jsonl import write_json_atomic
+
+    argv, spool, vids = _base_args(tmp_path, sample_video, n_copies=1)
+    cfg = load_config("resnet", parse_dotlist(argv))
+    cfg.cache = False
+    cfg.serve_max_requests = 2
+    sanity_check(cfg, require_videos=False)
+    loop = serve.ServeLoop(cfg, out_root=str(tmp_path / "out"))
+
+    claimed = Path(spool) / "claimed"
+
+    def orphan(rid, owner=None):
+        src = Path(spool) / "requests" / f"{rid}.json"
+        if owner is None:
+            dst = claimed / f"{rid}.json"  # legacy flat claim
+        else:
+            dst = claimed / owner / f"{rid}.json"
+            dst.parent.mkdir(parents=True, exist_ok=True)
+        os.rename(src, dst)
+        return dst
+
+    now = time.time()
+    # dead owner: heartbeat 100s old on a 1s interval
+    r_dead = serve.submit_request(spool, [vids[0]], request_id="deadclaim")
+    orphan(r_dead, owner="deadhost-1")
+    write_json_atomic(Path(spool) / "_heartbeat_deadhost-1.json",
+                      {"host_id": "deadhost-1", "time": now - 100.0,
+                       "interval_s": 1.0, "final": False})
+    # dead owner, but the response already landed: drop, don't re-serve
+    r_answered = serve.submit_request(spool, [vids[0]],
+                                      request_id="answered")
+    orphan(r_answered, owner="deadhost-1")
+    write_json_atomic(Path(spool) / "done" / "answered.json",
+                      {"schema": serve.RESPONSE_SCHEMA, "id": "answered",
+                       "status": "done"})
+    # live owner: fresh heartbeat — its claim is its own business
+    r_live = serve.submit_request(spool, [vids[0]], request_id="liveclaim")
+    live_claim = orphan(r_live, owner="livehost-1")
+    write_json_atomic(Path(spool) / "_heartbeat_livehost-1.json",
+                      {"host_id": "livehost-1", "time": now,
+                       "interval_s": 30.0, "final": False})
+    # legacy flat claim: a pre-reclamation server version crashed
+    r_legacy = serve.submit_request(spool, [vids[0]], request_id="legacy")
+    orphan(r_legacy)
+
+    assert loop._reclaim_orphans() == 2  # deadclaim + legacy
+    requeued = {p.stem for p in (Path(spool) / "requests").glob("*.json")}
+    assert requeued == {"deadclaim", "legacy"}
+    assert live_claim.exists(), "fresh-heartbeat owner's claim was stolen"
+    assert not (claimed / "deadhost-1" / "deadclaim.json").exists()
+    assert not (claimed / "deadhost-1" / "answered.json").exists()
+    assert loop._reclaim_orphans() == 0  # idempotent
+
+    # a running server picks the reclaimed requests up end-to-end
+    t = threading.Thread(target=loop.run, daemon=True)
+    t.start()
+    try:
+        resp = serve.wait_response(spool, "deadclaim", timeout_s=240)
+        assert resp["status"] == "done", resp
+        resp = serve.wait_response(spool, "legacy", timeout_s=240)
+        assert resp["status"] == "done", resp
+    finally:
+        loop.stop()
+        t.join(timeout=60)
+    assert not t.is_alive()

@@ -152,10 +152,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     video_paths = form_list_from_user_input(
         args.get("video_paths"), args.get("file_with_video_paths"),
         to_shuffle=True)
-    # multi-host: keep only this host's deterministic shard of the work list
-    # (jax.process_count() is 1 when jax.distributed is not initialized)
-    from .parallel.mesh import local_shard_of_list
-    video_paths = local_shard_of_list(video_paths)
+    # multi-host partitioning, fleet= config key (sanity_check-validated):
+    #   static (default) — keep only this host's deterministic hash shard
+    #     of the work list, byte-identical to the pre-queue behavior
+    #     (jax.process_count() is 1 when jax.distributed is not up);
+    #   queue — every host sees the FULL list and seeds the shared
+    #     work-stealing queue instead (parallel/queue.py, constructed
+    #     below once the telemetry recorder exists to renew leases)
+    fleet_mode = str(args.get("fleet", "static") or "static")
+    if fleet_mode != "queue":
+        from .parallel.mesh import local_shard_of_list
+        video_paths = local_shard_of_list(video_paths)
 
     # profile=true: per-stage decode/forward/write breakdown at the end;
     # profile_trace_dir=/path: additionally capture a jax.profiler trace
@@ -227,6 +234,15 @@ def main(argv: Optional[List[str]] = None) -> None:
             host_id = f"p{jax.process_index()}-{host_id}"
         except Exception:
             pass
+        if fleet_mode == "queue":
+            # lease ownership + heartbeat files are keyed on host_id, and
+            # queue workers may legitimately share one machine (tests,
+            # smoke gates, over-subscribed hosts) — pid + a nonce keep
+            # each worker's identity, claims dir and liveness file
+            # distinct even for in-process sibling workers
+            import os
+            import uuid
+            host_id = f"{host_id}-{os.getpid()}-{uuid.uuid4().hex[:4]}"
         run_config = (_plain(args) if not multi_mode else
                       {"feature_type": run_label,
                        "families": {f: _plain(a)
@@ -252,6 +268,29 @@ def main(argv: Optional[List[str]] = None) -> None:
         from .telemetry.trace import TraceRecorder
         tracer = TraceRecorder(out_root).start()
 
+    # Work-stealing fleet queue (fleet=queue, parallel/queue.py): instead
+    # of owning a fixed hash shard, this host claims videos one at a time
+    # from the shared {out_root}/_queue/ by atomic rename, renews its
+    # lease stamps from the heartbeat flusher thread (extra_sections
+    # hook), and steals expired leases when idle — fleet makespan
+    # approaches total_work/n_hosts instead of max(shard). sanity_check
+    # guarantees recorder is live here (fleet=queue needs telemetry=true).
+    work_queue = None
+    if fleet_mode == "queue":
+        if recorder is None:  # library callers can bypass sanity_check
+            raise ValueError("fleet=queue needs telemetry=true: the "
+                             "heartbeat thread renews the work-item leases")
+        from .parallel.queue import WorkQueue
+        work_queue = WorkQueue(
+            out_root, host_id=host_id, run_id=recorder.run_id,
+            lease_s=float(args.get("fleet_lease_s") or 60.0),
+            max_reclaims=int(args.get("fleet_max_reclaims") or 3),
+            journal=(journal if not multi_mode else None))
+        recorder.extra_sections["fleet"] = work_queue.heartbeat_section
+        seeded = work_queue.seed(video_paths)
+        print(f"fleet: queue mode — seeded {seeded} new item(s) into "
+              f"{work_queue.root} as {host_id}")
+
     # Output health (health=true): per-(video, family) feature digests at
     # the sink boundary, appended to each family's {output_path}/
     # _health.jsonl, with NaN/Inf outputs quarantined via the faults
@@ -262,9 +301,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                      for a in per_family.values())
                  if multi_mode else bool(args.get("health", False)))
 
-    def run_one(video_path: str) -> None:
+    def run_one(video_path: str) -> str:
+        """Extract one video; the returned status feeds the fleet queue's
+        done marker ('dropped' = preempted before starting, the queue
+        releases the claim instead of completing it)."""
         if stop.is_set():
-            return
+            return "dropped"
         with tally_lock:
             videos_run[0] += 1
         if multi is not None:
@@ -274,7 +316,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                 for fam, status in statuses.items():
                     tally[status] += 1
                     fam_tally[fam][status] += 1
-            return
+            # one done marker per video: the worst per-family verdict
+            for agg in ("error", "quarantined", "done"):
+                if agg in statuses.values():
+                    return agg
+            return "skipped"
         span_cm = (recorder.video_span(video_path)
                    if recorder is not None else NOOP_SPAN)
         with span_cm as span:
@@ -285,10 +331,56 @@ def main(argv: Optional[List[str]] = None) -> None:
             span.annotate(status=status)
         with tally_lock:
             tally[status] += 1
+        return status
+
+    def canary_extract(video_path: str, canary_dir: str):
+        """Joining-host canary (fleet_canary=true): re-extract one
+        already-completed video into a throwaway dir with a FRESH
+        extractor — cache off (the gate must recompute, not re-serve)
+        and health on (compare_runs digest bands need digests)."""
+        from .config import Config, _plain
+        c_args = Config(_plain(args))
+        c_args.output_path = canary_dir
+        c_args.cache = False
+        c_args.health = True
+        c_ext = get_extractor_cls(args.feature_type)(c_args)
+        t0 = time.perf_counter()
+        status = safe_extract(c_ext._extract, video_path, policy=policy,
+                              journal=None, decode_mode=c_ext.video_decode)
+        return status, time.perf_counter() - t0
 
     try:
         with TraceCapture(args.get("profile_trace_dir")):
-            if workers <= 1:
+            if work_queue is not None:
+                if bool(args.get("fleet_canary", False)):
+                    if multi_mode:
+                        print("fleet canary: multi-family runs are not "
+                              "canary-gated yet — claims open (per-family "
+                              "health gates still apply)")
+                    else:
+                        ok, lines = work_queue.canary_gate(canary_extract)
+                        print("\n".join(lines))
+                        if not ok:
+                            raise SystemExit(
+                                "fleet canary: FAILED — this host is gated "
+                                "out of the queue (digest or timing drift; "
+                                "verdict in "
+                                f"{work_queue.root}/canary/, docs/fleet.md)")
+                # claim -> extract -> complete until the queue is drained
+                # FLEET-wide; the bar tracks this host's completions
+                # against the full corpus (other hosts take the rest)
+                pbar = tqdm(total=len(video_paths), desc="fleet")
+                try:
+                    work_queue.drain(
+                        run_one, workers=workers, stop=stop,
+                        on_complete=lambda rec, status: pbar.update(1))
+                finally:
+                    pbar.close()
+                    # escaped-exception / preemption safety net: hand any
+                    # still-held claims back unbumped so another host
+                    # re-dispatches them immediately
+                    work_queue.release_all()
+            elif workers <= 1:
                 for video_path in tqdm(video_paths):
                     if stop.is_set():
                         break

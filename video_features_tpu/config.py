@@ -346,6 +346,38 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
                          "null (null -> VFT_CACHE_DIR or "
                          "~/.cache/video_features_tpu/feature_cache)")
 
+    # fleet scheduling keys (parallel/queue.py): validated at launch —
+    # a typo'd fleet mode must fail before N hosts start claiming
+    fl = args.get("fleet", "static") or "static"
+    if fl not in ("static", "queue"):
+        raise ValueError(f"fleet={fl!r}: expected 'static' (md5 hash "
+                         "sharding fixed at launch) or 'queue' (the "
+                         "work-stealing lease queue, docs/fleet.md)")
+    if fl == "queue":
+        if not args.get("telemetry", False):
+            raise ValueError(
+                "fleet=queue needs telemetry=true: the heartbeat flusher "
+                "thread renews work-item leases and heartbeats are the "
+                "fleet membership/liveness signal (docs/fleet.md)")
+        if args.get("on_extraction", "print") == "print":
+            raise ValueError(
+                "fleet=queue needs a file sink (on_extraction=save_numpy "
+                "or save_pickle): stolen work relies on the idempotent "
+                "skip-if-exists output contract, which print lacks")
+    fls = args.get("fleet_lease_s")
+    if fls is not None and float(fls) <= 0:
+        raise ValueError(f"fleet_lease_s={fls!r}: need a float > 0 (the "
+                         "work-item lease period; renewed every heartbeat)")
+    fmr = args.get("fleet_max_reclaims")
+    if fmr is not None and int(fmr) < 1:
+        raise ValueError(f"fleet_max_reclaims={fmr!r}: need an int >= 1 "
+                         "(reclaims before an item is quarantined)")
+    fca = args.get("fleet_canary", False)
+    if not isinstance(fca, bool):
+        raise ValueError(f"fleet_canary={fca!r}: expected true or false "
+                         "(gate joining hosts on a re-extracted slice, "
+                         "docs/fleet.md)")
+
     # resize=auto|host|device (extractors/base.py _resolve_resize_mode):
     # 'auto' (the default) picks 'device' for save sinks and 'host' for
     # print/show_pred and for families without a fused device resize

@@ -1,0 +1,774 @@
+"""Work-stealing fleet queue: filesystem-coordinated dynamic work claims.
+
+Static hash sharding (:func:`~.mesh.local_shard_of_list`) fixes every
+video's owner at launch, so fleet makespan is the *slowest shard* — one
+long video, one throttled host or one preempted worker idles every other
+chip while it finishes, and membership cannot change mid-run. This module
+replaces that with a dynamic queue coordinated purely through the shared
+output filesystem (no new daemon, no coordinator): makespan approaches
+``total_work / n_hosts`` instead of ``max(shard)``.
+
+Layout, under the run's shared output root::
+
+    {out_root}/_queue/
+      pending/{item_id}.json            un-owned work items
+      claimed/{host_id}/{item_id}.json  leased items (lease stamp inside)
+      done/{item_id}.json               completion records (first writer wins)
+      quarantined/{item_id}.json        pathological items (>= max_reclaims)
+      .staging/                         reclaim-in-progress scratch
+      canary/{host_id}/                 joining-host canary slice + verdict
+
+**Claim discipline**: ``os.rename(pending/x, claimed/{me}/x)`` — atomic
+on POSIX, a losing racer just sees ENOENT (the exact discipline the
+serve.py request spool proved). After the rename the claimant owns the
+file exclusively and stamps a lease ``{host_id, run_id, claim_time,
+deadline, reclaims}`` with an atomic replace.
+
+**Leases** are renewed from the existing telemetry heartbeat flusher
+thread (:meth:`WorkQueue.heartbeat_section` is installed as a
+``recorder.extra_sections`` hook, so every heartbeat tick both publishes
+fleet state and pushes the deadlines of this host's active claims
+forward). A host that dies — or stalls past its heartbeat — stops
+renewing, and its leases expire.
+
+**Stealing**: an idle host (:meth:`reclaim_expired`) scans other hosts'
+claim dirs for leases that are past-deadline or whose owner's heartbeat
+is stale/final, moves them back to ``pending/`` with ``reclaims`` bumped
+(atomically, via a staging rename so two stealers cannot both requeue),
+and claims them like any other item. An item reclaimed more than
+``max_reclaims`` times is *pathological* — it has now taken down (or
+outlived) several workers — and routes through the existing quarantine
+journal (utils/faults.py) instead of being re-dispatched forever.
+
+**Membership** is discovered, not configured: any process that seeds the
+same list into the same queue root participates; hosts may join or leave
+mid-run. Joining hosts can be gated by the **canary**
+(:meth:`canary_gate`): re-extract a slice of already-completed work and
+pass compare_runs.py digest bands + bench_history.py timing bands before
+claiming freely — a bad binary/config on a new host fails its canary
+instead of poisoning the run.
+
+**Exactly-once extraction** is the layered contract: a video is always
+represented in >= 1 of {pending, claimed, done}; completion writes the
+``done/`` marker with ``O_EXCL`` (first writer wins) *before* the claim
+is released; claimants discard a claim whose done marker already exists;
+and the sinks' idempotent skip-if-exists + atomic writes are the final
+backstop — duplicate *dispatch* (possible after a reclaim race) can never
+become duplicate or torn *output*.
+
+``fleet=static`` (the default) bypasses all of this and keeps the
+hash-sharding behavior byte-identical. See docs/fleet.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..telemetry import trace
+from ..telemetry.heartbeat import STALL_INTERVALS, heartbeat_filename
+from ..telemetry.jsonl import write_json_atomic
+
+QUEUE_DIRNAME = "_queue"
+PENDING, CLAIMED, DONE, QUARANTINED = ("pending", "claimed", "done",
+                                       "quarantined")
+STAGING = ".staging"
+ITEM_SCHEMA = "vft.fleet_item/1"
+DONE_SCHEMA = "vft.fleet_done/1"
+
+#: orphaned staging files (a stealer died mid-reclaim) older than this
+#: many lease periods are recovered back into pending/
+STAGING_ORPHAN_LEASES = 4.0
+
+
+def _safe(name: str) -> str:
+    """Filesystem-safe id (host ids embed hostnames, stems embed user
+    filenames) — same sanitation as telemetry/heartbeat.py."""
+    return re.sub(r"[^A-Za-z0-9._-]+", "-", str(name))
+
+
+def item_id(video: str) -> str:
+    """Stable, collision-free, filesystem-safe id for one work item:
+    readable stem prefix + a hash of the full path (stems are unique
+    within a run — sanity_check — but the hash keeps ids safe across
+    runs that reuse the queue root with different directories)."""
+    stem = _safe(Path(str(video)).stem)[:80]
+    h = hashlib.md5(str(video).encode()).hexdigest()[:10]
+    return f"{stem}-{h}"
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+class WorkQueue:
+    """One host's handle on the shared fleet queue.
+
+    ``clock`` is injectable so tests exercise lease expiry without
+    sleeping; everything else is plain filesystem state, so N instances
+    (threads, processes, or hosts on a shared mount) coordinate with no
+    other channel.
+    """
+
+    def __init__(self, out_root: str, *, host_id: str,
+                 run_id: Optional[str] = None,
+                 lease_s: float = 60.0, max_reclaims: int = 3,
+                 journal=None,
+                 clock: Callable[[], float] = time.time) -> None:
+        if float(lease_s) <= 0:
+            raise ValueError(f"fleet_lease_s={lease_s}: need > 0")
+        if int(max_reclaims) < 1:
+            raise ValueError(f"fleet_max_reclaims={max_reclaims}: need >= 1")
+        self.out_root = str(out_root)
+        self.root = os.path.join(self.out_root, QUEUE_DIRNAME)
+        self.host_id = str(host_id)
+        self.run_id = run_id
+        self.lease_s = float(lease_s)
+        self.max_reclaims = int(max_reclaims)
+        self.journal = journal
+        self.clock = clock
+        self.host_dir = os.path.join(self.root, CLAIMED, _safe(self.host_id))
+        for d in (PENDING, DONE, QUARANTINED, STAGING):
+            os.makedirs(os.path.join(self.root, d), exist_ok=True)
+        os.makedirs(self.host_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._active: Dict[str, dict] = {}  # item_id -> claim record
+        self._tallies = {"claimed": 0, "stolen": 0, "reclaimed": 0,
+                         "requeued": 0, "done": 0, "quarantined": 0,
+                         "lease_lost": 0, "duplicate_discarded": 0}
+        self._canary_state = "off"
+
+    # -- path helpers -------------------------------------------------------
+    def _p(self, *parts: str) -> str:
+        return os.path.join(self.root, *parts)
+
+    def _done_path(self, iid: str) -> str:
+        return self._p(DONE, f"{iid}.json")
+
+    def _claim_path(self, iid: str) -> str:
+        return os.path.join(self.host_dir, f"{iid}.json")
+
+    # -- seeding ------------------------------------------------------------
+    def seed(self, videos: List[str]) -> int:
+        """Idempotently publish the work list: every video not already
+        pending/claimed/done/quarantined gets a ``pending/`` item
+        (``O_EXCL``, so concurrent seeders never duplicate). Every host
+        seeds the same list at startup — a late joiner recovers items a
+        reclaimer lost mid-move, and already-finished work stays
+        finished (claimants re-check the done marker, see claim_next)."""
+        added = 0
+        for video in videos:
+            iid = item_id(video)
+            if os.path.exists(self._done_path(iid)) or \
+                    os.path.exists(self._p(QUARANTINED, f"{iid}.json")) or \
+                    self._claimed_anywhere(iid):
+                continue
+            rec = {"schema": ITEM_SCHEMA, "id": iid, "video": str(video),
+                   "reclaims": 0, "seeded_by": self.host_id,
+                   "time": round(self.clock(), 3)}
+            try:
+                # O_EXCL create, not rename-into-place: a rename would
+                # clobber a concurrent seeder's (or requeuer's) item
+                fd = os.open(self._p(PENDING, f"{iid}.json"),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(rec, f)
+                added += 1
+            except FileExistsError:
+                pass
+        return added
+
+    def _claimed_anywhere(self, iid: str) -> bool:
+        claimed_root = self._p(CLAIMED)
+        try:
+            hosts = os.listdir(claimed_root)
+        except OSError:
+            return False
+        return any(os.path.exists(os.path.join(claimed_root, h,
+                                               f"{iid}.json"))
+                   for h in hosts)
+
+    # -- claiming -----------------------------------------------------------
+    def claim_next(self) -> Optional[dict]:
+        """Claim the first pending item (name order, so seed order — the
+        operator can front-load known-long videos). Returns the stamped
+        claim record, or None when nothing is claimable."""
+        try:
+            names = sorted(n for n in os.listdir(self._p(PENDING))
+                           if n.endswith(".json"))
+        except OSError:
+            return None
+        for name in names:
+            src = self._p(PENDING, name)
+            dst = os.path.join(self.host_dir, name)
+            with trace.span("fleet.claim", item=name[:-len(".json")]):
+                try:
+                    os.rename(src, dst)
+                except OSError:
+                    continue  # another host won this item; try the next
+                rec = _read_json(dst) or {"id": name[:-len(".json")],
+                                          "video": None, "reclaims": 0}
+                iid = str(rec.get("id") or name[:-len(".json")])
+                if os.path.exists(self._done_path(iid)):
+                    # re-seed race lost to a completed item: the done
+                    # marker is ground truth — discard, never re-extract
+                    try:
+                        os.unlink(dst)
+                    except OSError:
+                        pass
+                    with self._lock:
+                        self._tallies["duplicate_discarded"] += 1
+                    continue
+                stolen = int(rec.get("reclaims", 0)) > 0 and \
+                    rec.get("last_owner") not in (None, self.host_id)
+                now = self.clock()
+                rec.update(host_id=self.host_id, run_id=self.run_id,
+                           claim_time=round(now, 3),
+                           deadline=round(now + self.lease_s, 3))
+                write_json_atomic(dst, rec)
+            with self._lock:
+                self._active[iid] = rec
+                self._tallies["claimed"] += 1
+                if stolen:
+                    self._tallies["stolen"] += 1
+            telemetry.inc("vft_fleet_claimed_total")
+            if stolen:
+                telemetry.inc("vft_fleet_stolen_total")
+                trace.instant("fleet.steal", item=iid,
+                              prev_owner=str(rec.get("last_owner")),
+                              reclaims=int(rec.get("reclaims", 0)))
+            return rec
+        return None
+
+    # -- lease maintenance --------------------------------------------------
+    def renew_leases(self) -> None:
+        """Push this host's active lease deadlines forward. Called from
+        the heartbeat flusher thread (via :meth:`heartbeat_section`) —
+        a live host's leases therefore never expire, and a dead/stalled
+        host's expire within one lease period."""
+        with self._lock:
+            active = dict(self._active)
+        now = self.clock()
+        for iid, rec in active.items():
+            path = self._claim_path(iid)
+            if not os.path.exists(path):
+                # stolen from under us (we stalled past the lease and
+                # somebody reclaimed): drop it — complete() re-checks too
+                with self._lock:
+                    if self._active.pop(iid, None) is not None:
+                        self._tallies["lease_lost"] += 1
+                continue
+            rec = dict(rec, deadline=round(now + self.lease_s, 3))
+            write_json_atomic(path, rec)
+            with self._lock:
+                if iid in self._active:
+                    self._active[iid] = rec
+
+    def _owner_stale(self, host_dirname: str,
+                     hb_cache: Dict[str, Optional[dict]]) -> bool:
+        """True when a claim-dir owner's heartbeat says it cannot renew:
+        missing (never started telemetry — impossible for a live queue
+        participant), marked final, or silent past the stall window."""
+        if host_dirname not in hb_cache:
+            hb_cache[host_dirname] = _read_json(
+                os.path.join(self.out_root, heartbeat_filename(host_dirname)))
+        hb = hb_cache[host_dirname]
+        if hb is None:
+            return True
+        if hb.get("final"):
+            return True
+        interval = float(hb.get("interval_s", 30.0) or 30.0)
+        age = self.clock() - float(hb.get("time", 0))
+        return age > STALL_INTERVALS * interval
+
+    def reclaim_expired(self) -> int:
+        """Steal back expired leases: every claim whose deadline passed,
+        or whose owner's heartbeat is stale/final, goes back to
+        ``pending/`` with ``reclaims`` bumped — unless it has been
+        reclaimed ``max_reclaims`` times already, in which case it is
+        quarantined as pathological. Returns the number of items made
+        claimable again."""
+        requeued = 0
+        hb_cache: Dict[str, Optional[dict]] = {}
+        claimed_root = self._p(CLAIMED)
+        try:
+            hosts = [h for h in os.listdir(claimed_root)
+                     if h != _safe(self.host_id)]
+        except OSError:
+            hosts = []
+        now = self.clock()
+        for host in hosts:
+            hdir = os.path.join(claimed_root, host)
+            try:
+                names = [n for n in os.listdir(hdir) if n.endswith(".json")]
+            except OSError:
+                continue
+            for name in names:
+                path = os.path.join(hdir, name)
+                rec = _read_json(path)
+                if rec is None:
+                    continue  # mid-stamp or torn; next scan decides
+                deadline = rec.get("deadline")
+                expired = deadline is not None and float(deadline) < now
+                if not expired and not self._owner_stale(host, hb_cache):
+                    continue
+                if self._requeue(path, rec, reason="lease expired"
+                                 if expired else "owner heartbeat stale"):
+                    requeued += 1
+        requeued += self._sweep_staging(now)
+        return requeued
+
+    def _requeue(self, claimed_path: str, rec: dict, *,
+                 reason: str, bump: bool = True) -> bool:
+        """Atomically move one claim back to pending (or quarantine).
+        The staging rename is the mutual exclusion: exactly one stealer
+        wins the source file."""
+        iid = str(rec.get("id") or Path(claimed_path).stem)
+        staging = self._p(STAGING, f"{uuid.uuid4().hex[:8]}.{iid}.json")
+        try:
+            os.rename(claimed_path, staging)
+        except OSError:
+            return False  # another stealer (or the owner's unlink) won
+        prev_owner = rec.get("host_id")
+        reclaims = int(rec.get("reclaims", 0)) + (1 if bump else 0)
+        rec = {"schema": ITEM_SCHEMA, "id": iid, "video": rec.get("video"),
+               "reclaims": reclaims, "last_owner": prev_owner,
+               "seeded_by": rec.get("seeded_by"),
+               "time": round(self.clock(), 3)}
+        if bump:
+            with self._lock:
+                self._tallies["reclaimed"] += 1
+            telemetry.inc("vft_fleet_reclaimed_total")
+            trace.instant("fleet.reclaim", item=iid,
+                          prev_owner=str(prev_owner), reason=reason,
+                          reclaims=reclaims)
+        if bump and reclaims > self.max_reclaims:
+            self._quarantine(rec, staging)
+            return False  # off the queue, not claimable
+        write_json_atomic(self._p(PENDING, f"{iid}.json"), rec)
+        with self._lock:
+            self._tallies["requeued"] += 1
+        telemetry.inc("vft_fleet_requeued_total")
+        try:
+            os.unlink(staging)
+        except OSError:
+            pass
+        return True
+
+    def _quarantine(self, rec: dict, staging: str) -> None:
+        """Route a pathological item (reclaimed past the cap — it has
+        repeatedly outlived or taken down its claimants) through the
+        existing quarantine machinery: a queue-level marker plus a
+        POISON record in the failure journal, so restarted workers and
+        ``retry_failed=true`` follow the PR-1 contract unchanged."""
+        iid = str(rec.get("id"))
+        write_json_atomic(self._p(QUARANTINED, f"{iid}.json"), rec)
+        with self._lock:
+            self._tallies["quarantined"] += 1
+        telemetry.inc("vft_fleet_quarantined_total")
+        trace.instant("fleet.quarantine", item=iid,
+                      reclaims=int(rec.get("reclaims", 0)))
+        if self.journal is not None and rec.get("video"):
+            try:
+                from ..utils.faults import POISON
+                self.journal.record(
+                    rec["video"], POISON, attempts=int(rec["reclaims"]),
+                    error=f"fleet: lease reclaimed {rec['reclaims']}x "
+                          f"(> fleet_max_reclaims={self.max_reclaims}); "
+                          "item repeatedly killed or outlived its workers",
+                    elapsed_s=0.0)
+            except Exception:
+                pass  # the quarantine marker alone still blocks re-dispatch
+        try:
+            os.unlink(staging)
+        except OSError:
+            pass
+
+    def _sweep_staging(self, now: float) -> int:
+        """Recover items a stealer lost mid-reclaim (died between the
+        staging rename and the pending write): anything in .staging/
+        older than several lease periods goes back to pending unless its
+        done marker exists."""
+        recovered = 0
+        try:
+            names = [n for n in os.listdir(self._p(STAGING))
+                     if n.endswith(".json")]
+        except OSError:
+            return 0
+        for name in names:
+            path = self._p(STAGING, name)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age < STAGING_ORPHAN_LEASES * self.lease_s:
+                continue
+            rec = _read_json(path)
+            if rec is None or not rec.get("id"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if os.path.exists(self._done_path(str(rec["id"]))):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if self._requeue(path, rec, reason="staging orphan",
+                             bump=False):
+                recovered += 1
+        return recovered
+
+    # -- completion / release -----------------------------------------------
+    def complete(self, rec: dict, status: str, *,
+                 elapsed_s: Optional[float] = None,
+                 families: Optional[Dict[str, str]] = None) -> bool:
+        """Publish one item's completion. First writer wins (``O_EXCL``):
+        if another host finished a stolen copy first, this host's result
+        is identical anyway (idempotent sinks) and only the marker race
+        is lost. Returns True when this host's record became the
+        marker."""
+        iid = str(rec.get("id"))
+        done = {"schema": DONE_SCHEMA, "id": iid,
+                "video": rec.get("video"), "status": str(status),
+                "by": self.host_id, "run_id": self.run_id,
+                "reclaims": int(rec.get("reclaims", 0)),
+                "time": round(self.clock(), 3)}
+        if elapsed_s is not None:
+            done["elapsed_s"] = round(float(elapsed_s), 3)
+        if families:
+            done["families"] = dict(families)
+        won = True
+        try:
+            fd = os.open(self._done_path(iid),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(done, f)
+        except FileExistsError:
+            won = False
+        with self._lock:
+            self._active.pop(iid, None)
+            self._tallies["done" if won else "lease_lost"] += 1
+        try:
+            os.unlink(self._claim_path(iid))
+        except OSError:
+            pass
+        return won
+
+    def release(self, rec: dict) -> None:
+        """Voluntarily hand a claim back (SIGTERM drain, escaped driver
+        exception): the item returns to pending WITHOUT a reclaim bump —
+        a graceful exit is not a pathology signal."""
+        iid = str(rec.get("id"))
+        with self._lock:
+            self._active.pop(iid, None)
+        path = self._claim_path(iid)
+        if os.path.exists(path):
+            self._requeue(path, rec, reason="released", bump=False)
+
+    def release_all(self) -> int:
+        with self._lock:
+            active = list(self._active.values())
+        for rec in active:
+            self.release(rec)
+        return len(active)
+
+    # -- state --------------------------------------------------------------
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for d in (PENDING, DONE, QUARANTINED):
+            try:
+                out[d] = sum(1 for n in os.listdir(self._p(d))
+                             if n.endswith(".json"))
+            except OSError:
+                out[d] = 0
+        claimed = 0
+        try:
+            for h in os.listdir(self._p(CLAIMED)):
+                try:
+                    claimed += sum(
+                        1 for n in os.listdir(self._p(CLAIMED, h))
+                        if n.endswith(".json"))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        out[CLAIMED] = claimed
+        return out
+
+    def all_done(self) -> bool:
+        c = self.counts()
+        return c[PENDING] == 0 and c[CLAIMED] == 0
+
+    def live_hosts(self) -> List[str]:
+        """Queue membership right now: host_ids with a fresh, non-final
+        heartbeat in the output root (joiners appear, leavers age out —
+        nothing is fixed at launch)."""
+        import glob as _glob
+        out = []
+        now = self.clock()
+        for p in _glob.glob(os.path.join(self.out_root,
+                                         "_heartbeat_*.json")):
+            hb = _read_json(p)
+            if hb is None or hb.get("final"):
+                continue
+            interval = float(hb.get("interval_s", 30.0) or 30.0)
+            if now - float(hb.get("time", 0)) <= STALL_INTERVALS * interval:
+                out.append(str(hb.get("host_id")))
+        return sorted(out)
+
+    def heartbeat_section(self) -> dict:
+        """The ``fleet`` heartbeat section AND the lease-renewal tick:
+        installed as a ``recorder.extra_sections`` hook so the existing
+        heartbeat flusher thread keeps this host's claims alive and
+        publishes fleet state in one atomic heartbeat write."""
+        self.renew_leases()
+        with self._lock:
+            tallies = dict(self._tallies)
+            active = dict(self._active)
+        now = self.clock()
+        oldest = max((now - float(r.get("claim_time", now))
+                      for r in active.values()), default=0.0)
+        return {"mode": "queue", "lease_s": self.lease_s,
+                "host_id": self.host_id,
+                "active_claims": len(active),
+                "oldest_active_claim_age_s": round(oldest, 3),
+                "queue": self.counts(), "canary": self._canary_state,
+                **tallies}
+
+    # -- the drain loop ------------------------------------------------------
+    def drain(self, run_fn: Callable[[str], str], *, workers: int = 1,
+              stop: Optional[threading.Event] = None, poll_s: float = 0.5,
+              on_complete: Optional[Callable[[dict, str], None]] = None
+              ) -> None:
+        """Claim -> extract -> complete until the queue is drained
+        fleet-wide. ``run_fn(video) -> status`` ('done'/'skipped'/
+        'error'/'quarantined', or 'dropped' when preempted — dropped
+        items are released, not completed). When pending is empty this
+        host steals expired leases; when other hosts still hold live
+        leases it idle-waits (the per-host idle tail
+        ``fleet.idle_wait`` spans make visible in trace_report.py)."""
+        stop = stop if stop is not None else threading.Event()
+        errors: List[BaseException] = []
+
+        def loop() -> None:
+            while not stop.is_set():
+                rec = self.claim_next()
+                if rec is None:
+                    if self.reclaim_expired() > 0:
+                        continue
+                    if self.all_done():
+                        return
+                    with trace.span("fleet.idle_wait"):
+                        stop.wait(poll_s)
+                    continue
+                video = rec.get("video")
+                t0 = time.perf_counter()
+                try:
+                    status = run_fn(str(video))
+                except BaseException as e:
+                    # an ESCAPED exception is a driver bug, not a video
+                    # verdict: hand the item back for another host
+                    self.release(rec)
+                    errors.append(e)
+                    return
+                if status == "dropped":
+                    self.release(rec)
+                    return
+                self.complete(rec, status,
+                              elapsed_s=time.perf_counter() - t0)
+                if on_complete is not None:
+                    on_complete(rec, status)
+
+        if workers <= 1:
+            loop()
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix="vft-fleet") as pool:
+                for f in [pool.submit(loop) for _ in range(workers)]:
+                    f.result()
+        if errors:
+            raise errors[0]
+
+    # -- canary gating -------------------------------------------------------
+    def canary_gate(self, extract_fn, *, slice_n: int = 2,
+                    band: float = 1.0, atol: float = 1e-2,
+                    rtol: float = 0.02) -> Tuple[bool, List[str]]:
+        """Gate a joining host before it may claim freely: re-extract up
+        to ``slice_n`` videos that OTHER hosts already completed into a
+        private canary dir, then hold the results against
+
+          - **compare_runs.py digest bands**: the canary's feature
+            digests (health=true) must sit inside the same atol/rtol
+            bands compare_runs applies between runs — a new binary or
+            config that drifts the numerics fails here, on throwaway
+            output, instead of inside the shared run;
+          - **bench_history.py timing bands**: the canary's best
+            seconds-per-video vs the fleet's recorded times for the same
+            videos, through check_regressions' banding (generous default
+            ``band=1.0`` = 2x: a joining host pays cold compiles).
+
+        ``extract_fn(video, out_dir) -> (status, elapsed_s)`` is supplied
+        by the driver (cli.py builds a cache-disabled extractor pointed
+        at the canary dir). A founding member — no completed work by
+        other hosts yet — passes trivially: there is nothing to compare
+        against, and the run-level health gates still apply."""
+        self._canary_state = "running"
+        lines: List[str] = []
+        sample = []
+        try:
+            names = sorted(n for n in os.listdir(self._p(DONE))
+                           if n.endswith(".json"))
+        except OSError:
+            names = []
+        for name in names:
+            rec = _read_json(self._p(DONE, name))
+            if rec is None or rec.get("by") == self.host_id:
+                continue
+            if rec.get("status") != "done" or not rec.get("video"):
+                continue
+            if os.path.exists(str(rec["video"])):
+                sample.append(rec)
+        if not sample:
+            self._canary_state = "founding"
+            return True, ["fleet canary: founding member — no completed "
+                          "work by other hosts yet, claims open"]
+        sample = sample[-int(slice_n):]
+        # fresh subdir per attempt: a rerun must re-extract, not ride the
+        # sinks' skip-if-exists over a previous attempt's output
+        canary_dir = self._p("canary", _safe(self.host_id),
+                             uuid.uuid4().hex[:8])
+        os.makedirs(canary_dir, exist_ok=True)
+        results = []
+        for rec in sample:
+            with trace.span("fleet.canary", item=str(rec.get("id"))):
+                status, elapsed = extract_fn(str(rec["video"]), canary_dir)
+            results.append((rec, status, elapsed))
+            lines.append(f"fleet canary: {Path(str(rec['video'])).name} -> "
+                         f"{status} in {elapsed:.2f}s (fleet did it in "
+                         f"{rec.get('elapsed_s', '?')}s)")
+        ok = all(status == "done" for _, status, _ in results)
+        if not ok:
+            lines.append("fleet canary: FAILED — canary extraction did not "
+                         "complete cleanly")
+        ok = self._canary_digests(canary_dir, atol, rtol, lines) and ok
+        ok = self._canary_timing(canary_dir, results, band, lines) and ok
+        verdict = {"schema": "vft.fleet_canary/1", "host_id": self.host_id,
+                   "run_id": self.run_id, "ok": bool(ok),
+                   "videos": [str(r.get("video")) for r, _, _ in results],
+                   "time": round(self.clock(), 3), "lines": lines}
+        write_json_atomic(self._p("canary", f"{_safe(self.host_id)}.json"),
+                          verdict)
+        self._canary_state = "passed" if ok else "failed"
+        return ok, lines
+
+    def _load_fleet_health(self) -> Dict[Tuple[str, str, str], dict]:
+        """The fleet's digests, EXCLUDING everything under the queue dir
+        (canary output lives there — comparing it against itself would
+        make the gate vacuous)."""
+        from ..telemetry.health import HEALTH_FILENAME
+        from ..telemetry.jsonl import read_jsonl
+        qroot = Path(self.root).resolve()
+        out: Dict[Tuple[str, str, str], dict] = {}
+        for path in sorted(Path(self.out_root).rglob(HEALTH_FILENAME)):
+            if qroot in path.resolve().parents:
+                continue
+            for rec in read_jsonl(path):
+                k = (os.path.basename(str(rec.get("video"))),
+                     str(rec.get("feature_type")), str(rec.get("key")))
+                out[k] = rec
+        return out
+
+    def _canary_digests(self, canary_dir: str, atol: float, rtol: float,
+                        lines: List[str]) -> bool:
+        cr = _load_script("compare_runs")
+        if cr is None:
+            lines.append("fleet canary: compare_runs.py unavailable "
+                         "(installed package without scripts/) — digest "
+                         "gate skipped")
+            return True
+        da = self._load_fleet_health()
+        db: Dict[Tuple[str, str, str], dict] = cr.load_health(canary_dir)
+        fails, infos, n = cr.compare_digests(da, db, atol, rtol)
+        if n == 0:
+            lines.append("fleet canary: no overlapping health digests "
+                         "(run with health=true fleet-wide for digest "
+                         "gating) — digest gate vacuous")
+            return True
+        lines += [f"fleet canary: DIGEST DRIFT {x}" for x in fails]
+        lines.append(f"fleet canary: {n} digest(s) compared against "
+                     f"compare_runs bands (atol={atol}, rtol={rtol}) — "
+                     + ("PASS" if not fails else "FAIL"))
+        return not fails
+
+    def _canary_timing(self, canary_dir: str, results, band: float,
+                       lines: List[str]) -> bool:
+        bh = _load_script("bench_history")
+        fleet_times = [float(r.get("elapsed_s", 0) or 0)
+                       for r, _, _ in results]
+        my_times = [float(e) for _, status, e in results
+                    if status in ("done", "skipped")]
+        fleet_times = [t for t in fleet_times if t > 0]
+        if bh is None or not fleet_times or not my_times:
+            lines.append("fleet canary: timing gate skipped "
+                         "(no comparable timings or bench_history.py "
+                         "unavailable)")
+            return True
+        fleet_med = sorted(fleet_times)[len(fleet_times) // 2]
+        # best canary video: the first one carries this host's cold
+        # compile/weights tax, which is a join cost, not a speed verdict
+        mine = min(my_times)
+        hist = os.path.join(canary_dir, "_canary_history.jsonl")
+        try:
+            os.unlink(hist)
+        except OSError:
+            pass
+        from ..telemetry.jsonl import append_jsonl
+        metric = "fleet canary seconds per video"
+        for rnd, val, src in ((1, fleet_med, "fleet"),
+                              (2, mine, self.host_id)):
+            append_jsonl(hist, {
+                "schema": bh.SCHEMA_VERSION, "round": rnd, "source": src,
+                "recorded_time": round(self.clock(), 3),
+                "headline": {"metric": metric, "value": round(val, 3),
+                             "unit": "seconds per video",
+                             "vs_baseline": None},
+                "metrics": []})
+        regressions, rep = bh.check_regressions(hist, band)
+        lines += [f"fleet canary: {x}" for x in rep[1:]]
+        lines.append(f"fleet canary: timing band ({band:.0%}) via "
+                     "bench_history check — "
+                     + ("PASS" if not regressions else "FAIL"))
+        return not regressions
+
+
+def _load_script(name: str):
+    """Import a repo-root scripts/ module (compare_runs, bench_history)
+    from a checkout; None when the package is installed without them —
+    canary gates degrade loudly, they never crash the run."""
+    import importlib.util
+    path = Path(__file__).resolve().parents[2] / "scripts" / f"{name}.py"
+    if not path.exists():
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(f"_vft_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None

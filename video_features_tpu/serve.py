@@ -30,14 +30,28 @@ docs/serving.md has the full contract):
 
   ======================  ==================================================
   ``{spool}/requests/``   clients atomically rename request JSON in
-  ``{spool}/claimed/``    server claims by ``os.rename`` (atomic; a losing
-                          racer just sees ENOENT)
+  ``{spool}/claimed/{host_id}/``  server claims by ``os.rename`` into its
+                          OWN subdir (atomic; a losing racer just sees
+                          ENOENT) — the dir name ties every claim to its
+                          owner's heartbeat, so a crashed server's claims
+                          are reclaimable (below), never orphaned
   ``{spool}/done/``       one response JSON per request (atomic replace)
   ``{spool}/_heartbeat_{host_id}.json``  liveness AND readiness: the
                           normal telemetry heartbeat (run_id-stamped,
                           PR 5 staleness semantics) plus a ``serve``
                           section — state, queue depths, request tallies
   ======================  ==================================================
+
+**Claim reclamation** (the fleet queue's lease discipline,
+parallel/queue.py, applied to the spool): a server that died mid-request
+used to strand its claims in ``claimed/`` forever. Now every live server
+periodically sweeps the other claim dirs; when an owner's heartbeat is
+missing, final, or silent past the stall window, its claimed requests are
+renamed back into ``requests/`` (first sweeper wins the rename) and
+served by whoever claims them next — unless the dead server already
+wrote the response, in which case the stale claim is simply dropped.
+Flat ``claimed/*.json`` files (a pre-reclamation server version crashed)
+have no identifiable owner and are reclaimed unconditionally.
 
 A request is ``{"id": ..., "video_paths": [...]}``; the response carries
 per-video statuses, artifact locations (the server's configured
@@ -229,6 +243,16 @@ class ServeLoop:
             host_id = f"p{jax.process_index()}-{host_id}"
         except Exception:
             pass
+        # pid-qualify: servers sharing one machine (and one spool) need
+        # distinct claim dirs + heartbeat files, and the claim-dir name
+        # must map 1:1 onto a heartbeat so sweepers can judge the owner
+        host_id = f"{host_id}-{os.getpid()}"
+        from .parallel.queue import _safe
+        self.claim_dirname = _safe(host_id)
+        self.claim_dir = os.path.join(self.paths[CLAIMED_DIR],
+                                      self.claim_dirname)
+        os.makedirs(self.claim_dir, exist_ok=True)
+        self._last_reclaim_sweep = 0.0
         families = (list(per_family) if per_family is not None
                     else [args.feature_type])
         self.families = families
@@ -365,13 +389,79 @@ class ServeLoop:
                 names,
                 key=lambda n: self._mtime(os.path.join(req_dir, n))):
             src = os.path.join(req_dir, name)
-            dst = os.path.join(self.paths[CLAIMED_DIR], name)
+            dst = os.path.join(self.claim_dir, name)
             try:
                 os.rename(src, dst)
                 return dst
             except OSError:
                 continue  # another server (or a withdrawal) won the race
         return None
+
+    def _reclaim_orphans(self) -> int:
+        """Release a dead server's spool claims (the fleet queue's
+        lease-expiry discipline): claims whose owner's heartbeat is
+        missing, final, or stale go back to ``requests/``; claims whose
+        response already landed are dropped. Returns requeued count."""
+        from .telemetry.heartbeat import STALL_INTERVALS, heartbeat_filename
+        root = self.paths[CLAIMED_DIR]
+        try:
+            entries = os.listdir(root)
+        except OSError:
+            return 0
+        requeued = 0
+        now = time.time()
+        for entry in entries:
+            p = os.path.join(root, entry)
+            if entry.endswith(".json") and os.path.isfile(p):
+                # flat claim: a pre-reclamation server crashed holding it;
+                # no owner dir means no heartbeat to wait out
+                requeued += self._release_claim(p)
+                continue
+            if entry == self.claim_dirname or not os.path.isdir(p):
+                continue
+            hb = None
+            try:
+                with open(os.path.join(self.spool_dir,
+                                       heartbeat_filename(entry)),
+                          encoding="utf-8") as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                pass
+            if hb is not None and not hb.get("final"):
+                interval = float(hb.get("interval_s", 30.0) or 30.0)
+                if now - float(hb.get("time", 0)) <= \
+                        STALL_INTERVALS * interval:
+                    continue  # owner is alive; its claims are its own
+            try:
+                names = [n for n in os.listdir(p) if n.endswith(".json")]
+            except OSError:
+                continue
+            for name in names:
+                requeued += self._release_claim(os.path.join(p, name))
+        return requeued
+
+    def _release_claim(self, path: str) -> int:
+        """Move one orphaned claim back to ``requests/`` (atomic rename;
+        a racing sweeper loses with ENOENT) — or drop it when its
+        response already exists (the owner died between respond and
+        cleanup; re-serving would only repeat finished work)."""
+        from . import telemetry
+        name = os.path.basename(path)
+        rid = name[:-len(".json")]
+        if os.path.exists(os.path.join(self.paths[DONE_DIR], name)):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return 0
+        try:
+            os.rename(path, os.path.join(self.paths[REQUESTS_DIR], name))
+        except OSError:
+            return 0  # a racing sweeper (or the resurrected owner) won
+        telemetry.inc("vft_serve_reclaimed_total")
+        print(f"vft-serve: reclaimed orphaned claim {rid} from a dead "
+              "server", file=sys.stderr)
+        return 1
 
     @staticmethod
     def _mtime(path: str) -> float:
@@ -394,7 +484,7 @@ class ServeLoop:
             return
         for name in names[self.max_pending:][::-1]:
             src = os.path.join(req_dir, name)
-            dst = os.path.join(self.paths[CLAIMED_DIR], name)
+            dst = os.path.join(self.claim_dir, name)
             try:
                 os.rename(src, dst)
             except OSError:
@@ -447,6 +537,13 @@ class ServeLoop:
                     futures = {f for f in futures if not f.done()}
                     with self._state_lock:
                         self._inflight = len(futures)
+                    # lease-expiry sweep on the heartbeat cadence: a dead
+                    # sibling's stall window is measured in its own
+                    # interval_s, so sweeping faster buys nothing
+                    if time.monotonic() - self._last_reclaim_sweep >= \
+                            min(self.recorder.interval_s, 5.0):
+                        self._last_reclaim_sweep = time.monotonic()
+                        self._reclaim_orphans()
                     self._reject_overflow()
                     claimed = None
                     if len(futures) < self.workers \

@@ -82,12 +82,19 @@ KNOWN_SPAN_NAMES = (
     "retry_backoff",        # fault-runtime sleep between attempts
     "wav_rip",              # ffmpeg audio rip (shared or private)
     "source_probe",         # private VideoSource construction/probing
+    "fleet.claim",          # work-queue claim attempt (parallel/queue.py)
+    "fleet.steal",          # instant: claimed a reclaimed item
+    "fleet.reclaim",        # instant: expired lease pushed back to pending
+    "fleet.idle_wait",      # queue empty, other hosts hold live leases
+    "fleet.canary",         # joining-host canary re-extraction
 )
 
-#: stall names ranked by scripts/trace_report.py "top stalls"
+#: stall names ranked by scripts/trace_report.py "top stalls" —
+#: fleet.idle_wait is the per-host idle TAIL (this worker out of work
+#: while a straggler finishes), the makespan cost work-stealing shrinks
 STALL_SPAN_NAMES = ("fanout.put_blocked", "fanout.get_starved",
                     "fanout.subscribe_wait", "prefetch.put_blocked",
-                    "retry_backoff")
+                    "retry_backoff", "fleet.idle_wait")
 
 #: stalls shorter than this never become trace events (they still
 #: accumulate into the telemetry counters): a healthy pipeline performs

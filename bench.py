@@ -526,6 +526,54 @@ def bench_health_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_inject_overhead(families=("resnet", "clip", "s3d"),
+                          n_copies: int = 2) -> dict:
+    """Wall-clock cost of the fault-injection sites (utils/inject.py) on
+    the same smoke corpus as bench_trace_overhead: the multi-family CLI
+    run, warmed untimed, then timed injection-off and with an ARMED plan
+    whose trigger can never fire. Off is the production path (every site
+    one global read); armed-but-quiet additionally pays the per-hit
+    counting plus the sinks' python atomic path — both must stay inside
+    the <= 1.05x budget the other always-on knobs hold."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the inject bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_inject_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_inject{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", [])
+        on = run("on", ["inject=seed=1;decode.read=eio@n999999999;"
+                        "sink.fsync=eio@n999999999"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_cache(family: str = "resnet", n_copies: int = 3) -> dict:
     """Repeat-content avoidance ratio (ISSUE 7): the SAME corpus run
     twice with ``cache=true`` into a fresh content-addressed store
@@ -1358,6 +1406,28 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: health-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # fault-injection sites (utils/inject.py): the off path is permanent
+    # production code on the sink/decode/queue hot paths, so its cost is
+    # tracked per round exactly like trace=/health= — armed-but-quiet vs
+    # off, <= 1.05x budget, bench-history gated
+    try:
+        io_ = bench_inject_overhead()
+        metrics.append({
+            "metric": "fault-injection overhead (armed-quiet vs off, "
+                      f"{'+'.join(io_['families'])})",
+            "value": io_["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": io_["off_s"],
+            "on_s": io_["on_s"],
+            "note": f"{io_['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs; armed plan with unreachable triggers "
+                    "pays per-hit counting + the python atomic sink path "
+                    "(docs/chaos.md) — off is one global read per site",
+        })
+    except Exception as e:
+        print(f"WARNING: inject-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # repeat-content avoidance (cache.py): second pass over the same
     # corpus must be near-pure cache-hit throughput; tracked per round

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""vft-audit, checkout form: audit an output directory against the
+cross-subsystem durability invariants (done markers <-> artifacts <->
+health digests, no orphaned claims/staging for finalized hosts, no .tmp
+litter, torn-tail-only jsonl, cache re-verification, artifact shas).
+
+Thin wrapper over ``video_features_tpu.audit`` (also installed as the
+``vft-audit`` console script) so an operator on a bare checkout can run
+``python scripts/audit_run.py /shared/out`` like the other scripts/
+tools. Exit 0 = PASS, 1 = FAIL with every violation listed; the full
+invariant list and rationale live in docs/chaos.md.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.audit import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Fault-injection quick-gate: injected faults must end in auditor PASS
+with the right journal records, and an armed-but-quiet plan must be
+byte-identical to stock (ISSUE 9).
+
+Sibling of the ``check_*_smoke.py`` gates, for the deterministic
+fault-injection plane (utils/inject.py) + run auditor
+(video_features_tpu/audit.py). Three real CPU runs over a tiny corpus:
+
+  1. **off-is-identical**: a run with an ARMED plan whose trigger can
+     never fire (``decode.read=eio@n999999``) must produce artifacts
+     byte-identical to a stock run — arming must not perturb the
+     pipeline (this also pins the write_numpy python-path/native-path
+     byte identity the armed route relies on);
+  2. **injected ENOSPC** (``sink.fsync=enospc@n1``): the first sink
+     write fails FATAL (utils/faults.py's disk-full taxonomy — exactly
+     one journal record, exactly one attempt, no retry burn), every
+     other video completes, no ``.tmp`` litter anywhere, and
+     ``vft-audit`` ends PASS;
+  3. **injected rename drop** (``sink.rename=drop@n1``): a transient
+     loss of the atomic rename is retried and fully recovered — zero
+     journal records, artifacts byte-identical to stock, auditor PASS.
+
+Exit 0 = contract holds; exit 1 = every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml); the in-suite twins are
+tests/test_inject.py (unit semantics), tests/test_audit.py (invariant
+isolation) and tests/test_chaos.py (the seeded chaos matrix).
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("VFT_INJECT", None)  # the gate's plans must be its own
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+N_VIDEOS = 3
+
+BASE = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "allow_random_weights=true", "on_extraction=save_numpy",
+        "extraction_total=4", "batch_size=8", "video_workers=1",
+        "telemetry=true", "metrics_interval_s=0.5", "health=true"]
+
+
+def _npy_map(root: Path) -> dict:
+    return {p.name: p.read_bytes() for p in root.rglob("*.npy")}
+
+
+def _journal(root: Path) -> List[dict]:
+    out = []
+    for p in root.rglob("_failures.jsonl"):
+        out += [json.loads(l) for l in p.read_text().splitlines()
+                if l.strip()]
+    return out
+
+
+def check_inject(td: Path) -> List[str]:
+    from video_features_tpu.audit import audit_run
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    vids = []
+    for i in range(N_VIDEOS):
+        dst = td / f"inj{i}.mp4"
+        shutil.copy(SAMPLE, dst)
+        vids.append(str(dst))
+    listfile = td / "videos.txt"
+    listfile.write_text("\n".join(vids) + "\n")
+    corpus = BASE + [f"tmp_path={td / 'tmp'}",
+                     f"file_with_video_paths={listfile}"]
+
+    def run(out: str, *extra: str) -> None:
+        with contextlib.redirect_stdout(io.StringIO()):
+            cli_main(corpus + [f"output_path={td / out}", *extra])
+
+    # ---- 1. armed-but-quiet must be byte-identical to stock ------------
+    run("stock")
+    run("quiet", "inject=seed=1;decode.read=eio@n999999")
+    stock, quiet = _npy_map(td / "stock"), _npy_map(td / "quiet")
+    if len([n for n in stock if n.endswith("_resnet.npy")]) != N_VIDEOS:
+        errs.append(f"stock run incomplete: {sorted(stock)}")
+    if stock != quiet:
+        errs.append("armed-but-never-firing inject run is NOT "
+                    "byte-identical to stock — arming perturbed the "
+                    "pipeline (the off-is-identical discipline)")
+
+    # ---- 2. injected ENOSPC: one fast FATAL, no litter, audit PASS -----
+    run("enospc", "inject=seed=2;sink.fsync=enospc@n1")
+    recs = _journal(td / "enospc")
+    if len(recs) != 1:
+        errs.append(f"ENOSPC run journaled {len(recs)} records, want "
+                    f"exactly 1: {recs}")
+    else:
+        r = recs[0]
+        if r.get("category") != "FATAL":
+            errs.append(f"ENOSPC must classify FATAL, got "
+                        f"{r.get('category')} (retrying a full disk burns "
+                        "the whole retry budget per video)")
+        if r.get("attempts") != 1:
+            errs.append(f"ENOSPC burned {r.get('attempts')} attempts, "
+                        "want 1 (FATAL must not retry)")
+        if "ENOSPC" not in str(r.get("error")):
+            errs.append(f"journal error lost the ENOSPC provenance: {r}")
+    done = [n for n in _npy_map(td / "enospc") if n.endswith("_resnet.npy")]
+    if len(done) != N_VIDEOS - 1:
+        errs.append(f"ENOSPC run finished {len(done)}/{N_VIDEOS - 1} "
+                    "healthy videos (per-video isolation broke)")
+    tmps = list((td / "enospc").rglob("*.tmp"))
+    if tmps:
+        errs.append(f"ENOSPC at fsync leaked tmp files: {tmps}")
+    ok, violations, _ = audit_run(str(td / "enospc"))
+    if not ok:
+        errs.append("vft-audit FAILED the ENOSPC run:\n    "
+                    + "\n    ".join(violations))
+
+    # ---- 3. injected rename drop: recovered, identical, audit PASS -----
+    run("rdrop", "inject=seed=3;sink.rename=drop@n1")
+    recs = _journal(td / "rdrop")
+    if recs:
+        errs.append(f"rename-drop must be retried and recovered, but "
+                    f"journaled: {recs}")
+    rdrop = _npy_map(td / "rdrop")
+    if rdrop != stock:
+        errs.append("rename-drop run is NOT byte-identical to stock "
+                    "after recovery")
+    ok, violations, _ = audit_run(str(td / "rdrop"))
+    if not ok:
+        errs.append("vft-audit FAILED the rename-drop run:\n    "
+                    + "\n    ".join(violations))
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"SKIP: vendored sample missing ({SAMPLE})")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_inject_smoke_") as td:
+        errs = check_inject(Path(td))
+    if errs:
+        print("INJECT SMOKE: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"INJECT SMOKE: OK ({N_VIDEOS} videos; armed-quiet byte-identical"
+          ", ENOSPC -> 1 fast FATAL + audit PASS, rename-drop recovered "
+          "bit-identically + audit PASS)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

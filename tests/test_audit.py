@@ -1,0 +1,232 @@
+"""The run-invariant auditor (video_features_tpu/audit.py, vft-audit).
+
+Each invariant is exercised on a synthetic output directory built from
+the same library primitives the real run uses (append_jsonl,
+content_signature, numpy artifacts, queue/done layouts), so the tests
+are fast and each violation class is isolated: a consistent dir PASSes,
+then one targeted mutation at a time must flip the verdict to FAIL with
+the violation named. The end-to-end composition (real CLI chaos runs
+ending in an audit) lives in tests/test_chaos.py.
+"""
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.audit import audit_run
+from video_features_tpu.telemetry.health import digest_array
+from video_features_tpu.telemetry.jsonl import append_jsonl
+
+pytestmark = pytest.mark.quick
+
+
+def _hash(data: bytes) -> str:
+    import hashlib
+    return hashlib.sha256(data).hexdigest()
+
+
+def _mk_consistent_run(root: Path) -> Path:
+    """A minimal but fully cross-linked output dir: one video, one
+    artifact, agreeing health digest + artifact span + queue done marker
+    + final heartbeat + an (explained) failure for a second video."""
+    root.mkdir(parents=True, exist_ok=True)
+    arr = np.arange(16, dtype=np.float32).reshape(4, 4)
+    np.save(root / "v0_resnet.npy", arr)
+    data = (root / "v0_resnet.npy").read_bytes()
+    # health digest of exactly that tensor
+    append_jsonl(root / "_health.jsonl",
+                 digest_array("resnet", arr, video="v0.mp4",
+                              feature_type="resnet"))
+    # span record with the artifact event (bytes + sha of what landed)
+    append_jsonl(root / "_telemetry.jsonl", {
+        "schema": "vft.video_span/1", "video": "v0.mp4", "status": "done",
+        "events": [{"kind": "artifact", "key": "resnet",
+                    "file": "v0_resnet.npy", "bytes": len(data),
+                    "sha256": _hash(data)}],
+    })
+    # queue: v0 done, v1 errored (journaled below), v2 quarantined+POISON
+    q = root / "_queue"
+    for d in ("pending", "done", "quarantined", ".staging"):
+        (q / d).mkdir(parents=True, exist_ok=True)
+    (q / "claimed" / "hostA").mkdir(parents=True, exist_ok=True)
+    (q / "done" / "v0-aaaa.json").write_text(json.dumps(
+        {"id": "v0-aaaa", "video": "v0.mp4", "status": "done",
+         "by": "hostA"}))
+    (q / "done" / "v1-bbbb.json").write_text(json.dumps(
+        {"id": "v1-bbbb", "video": "v1.mp4", "status": "error",
+         "by": "hostA"}))
+    (q / "quarantined" / "v2-cccc.json").write_text(json.dumps(
+        {"id": "v2-cccc", "video": "v2.mp4", "reclaims": 4}))
+    append_jsonl(root / "_failures.jsonl",
+                 {"video": "v1.mp4", "category": "FATAL", "attempts": 1,
+                  "error": "ValueError: boom"})
+    append_jsonl(root / "_failures.jsonl",
+                 {"video": "v2.mp4", "category": "POISON", "attempts": 3,
+                  "error": "fleet: reclaimed 4x"})
+    # hostA exited gracefully: final heartbeat, no claims left
+    (root / "_heartbeat_hostA.json").write_text(json.dumps(
+        {"host_id": "hostA", "final": True, "time": 0.0,
+         "interval_s": 1.0}))
+    return root
+
+
+@pytest.fixture()
+def run_dir(tmp_path):
+    return _mk_consistent_run(tmp_path / "out")
+
+
+def _assert_fail(root, needle, **kw):
+    ok, violations, _ = audit_run(str(root), **kw)
+    assert not ok, f"expected FAIL for {needle!r}"
+    assert any(needle in v for v in violations), \
+        f"no violation mentioning {needle!r} in {violations}"
+
+
+def test_consistent_run_passes(run_dir):
+    ok, violations, notes = audit_run(str(run_dir), expect_complete=True)
+    assert ok, violations
+
+
+def test_tmp_litter_fails(run_dir):
+    (run_dir / "v9_resnet.npy.k3j2.tmp").write_bytes(b"half a write")
+    _assert_fail(run_dir, "tmp litter")
+
+
+def test_corrupt_artifact_fails_health_reverify(run_dir):
+    path = run_dir / "v0_resnet.npy"
+    data = bytearray(path.read_bytes())
+    data[-3] ^= 0xFF  # flip a payload bit
+    path.write_bytes(bytes(data))
+    _assert_fail(run_dir, "signature mismatch")
+
+
+def test_artifact_span_sha_mismatch_fails(run_dir):
+    # rewrite the artifact with DIFFERENT (still loadable) content and a
+    # matching health record, so only the span sha can catch it
+    arr = np.zeros((4, 4), np.float32)
+    np.save(run_dir / "v0_resnet.npy", arr)
+    append_jsonl(run_dir / "_health.jsonl",
+                 digest_array("resnet", arr, video="v0.mp4",
+                              feature_type="resnet"))
+    _assert_fail(run_dir, "sha256")
+
+
+def test_recorded_artifact_missing_fails(run_dir):
+    (run_dir / "v0_resnet.npy").unlink()
+    _assert_fail(run_dir, "absent on disk")
+
+
+def test_midfile_torn_jsonl_fails_tail_torn_passes(run_dir):
+    path = run_dir / "_health.jsonl"
+    # tail tear: healable, a note not a violation
+    with open(path, "ab") as f:
+        f.write(b'{"schema": "vft.feature_health/1", "video": "torn')
+    ok, violations, notes = audit_run(str(run_dir), expect_complete=True)
+    assert ok, violations
+    assert any("torn trailing record" in n for n in notes)
+    # mid-file tear: impossible under single-write O_APPEND -> violation
+    with open(path, "ab") as f:
+        f.write(b'\n{"video": "v9.mp4"}\n')
+    _assert_fail(run_dir, "corrupt record at line")
+
+
+def test_done_marker_without_artifact_fails(run_dir):
+    q = run_dir / "_queue" / "done"
+    (q / "v7-dddd.json").write_text(json.dumps(
+        {"id": "v7-dddd", "video": "v7.mp4", "status": "done",
+         "by": "hostA"}))
+    _assert_fail(run_dir, "has no artifact")
+
+
+def test_error_marker_without_journal_record_fails(run_dir):
+    q = run_dir / "_queue" / "done"
+    (q / "v8-eeee.json").write_text(json.dumps(
+        {"id": "v8-eeee", "video": "v8.mp4", "status": "error",
+         "by": "hostA"}))
+    _assert_fail(run_dir, "no failure journal")
+
+
+def test_quarantined_without_poison_record_fails(run_dir):
+    (run_dir / "_queue" / "quarantined" / "v5-ffff.json").write_text(
+        json.dumps({"id": "v5-ffff", "video": "v5.mp4", "reclaims": 4}))
+    _assert_fail(run_dir, "no POISON record")
+
+
+def test_orphaned_claim_of_finalized_host_fails(run_dir):
+    claim = run_dir / "_queue" / "claimed" / "hostA" / "v3-gggg.json"
+    claim.write_text(json.dumps({"id": "v3-gggg", "video": "v3.mp4",
+                                 "host_id": "hostA", "deadline": 1.0}))
+    _assert_fail(run_dir, "orphaned claim")
+
+
+def test_claim_of_stale_host_is_recoverable_note(run_dir):
+    """A claim whose owner is merely dead-without-final-heartbeat is the
+    lease-steal case: recoverable, so a note — unless the run claims to
+    be complete."""
+    hostb = run_dir / "_queue" / "claimed" / "hostB"
+    hostb.mkdir()
+    (hostb / "v4-hhhh.json").write_text(json.dumps(
+        {"id": "v4-hhhh", "video": "v4.mp4", "host_id": "hostB",
+         "deadline": 1.0}))
+    ok, violations, notes = audit_run(str(run_dir))  # not expect_complete
+    assert ok, violations
+    assert any("in-flight claim" in n for n in notes)
+    _assert_fail(run_dir, "leftover claim", expect_complete=True)
+
+
+def test_stranded_staging_fails_when_all_hosts_final(run_dir):
+    staging = run_dir / "_queue" / ".staging" / "ab12cd34.v6-iiii.json"
+    staging.write_text(json.dumps({"id": "v6-iiii", "video": "v6.mp4"}))
+    _assert_fail(run_dir, "stranded in staging", expect_complete=True)
+    # same entry for an already-done item: dead weight, only a note
+    staging.write_text(json.dumps({"id": "v0-aaaa", "video": "v0.mp4"}))
+    ok, violations, notes = audit_run(str(run_dir), expect_complete=True)
+    assert ok, violations
+    assert any("staging leftover" in n for n in notes)
+
+
+def test_pending_leftover_fails_only_when_expect_complete(run_dir):
+    (run_dir / "_queue" / "pending" / "v6-jjjj.json").write_text(
+        json.dumps({"id": "v6-jjjj", "video": "v6.mp4"}))
+    ok, violations, _ = audit_run(str(run_dir))
+    assert ok, violations
+    _assert_fail(run_dir, "pending item", expect_complete=True)
+
+
+def test_nonfinite_health_record_with_artifact_fails(run_dir):
+    arr = np.full((2, 2), np.nan, np.float32)
+    np.save(run_dir / "v0_bad.npy", arr)
+    append_jsonl(run_dir / "_health.jsonl",
+                 digest_array("bad", arr, video="v0.mp4",
+                              feature_type="resnet"))
+    _assert_fail(run_dir, "non-finite")
+
+
+def test_cache_reverify(run_dir, tmp_path):
+    from video_features_tpu.cache import FeatureCache
+    video = tmp_path / "content.bin"
+    video.write_bytes(b"cache me")
+    store = tmp_path / "cachestore"
+    cache = FeatureCache(str(store / "resnet"), "resnet", "cfg", "wts")
+    cache.store(str(video), {"resnet": np.ones((3, 3), np.float32)})
+    ok, violations, _ = audit_run(str(run_dir), cache_dir=str(store),
+                                  expect_complete=True)
+    assert ok, violations
+    # corrupt the entry in place: re-verification must flag it
+    entry = next(store.rglob("*.pkl"))
+    data = bytearray(entry.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    entry.write_bytes(bytes(data))
+    _assert_fail(run_dir, "cache entry", cache_dir=str(store))
+
+
+def test_cli_verdict_and_exit_codes(run_dir, capsys):
+    from video_features_tpu.audit import main
+    assert main([str(run_dir), "--expect-complete"]) == 0
+    out = capsys.readouterr().out
+    assert "AUDIT: PASS" in out
+    (run_dir / "junk.tmp").write_bytes(b"x")
+    assert main([str(run_dir)]) == 1
+    out = capsys.readouterr().out
+    assert "AUDIT: FAIL" in out and "tmp litter" in out

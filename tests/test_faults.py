@@ -48,6 +48,33 @@ def test_classify_unknown_defaults_transient():
     assert faults.classify(Weird("?")) == faults.TRANSIENT
 
 
+def test_classify_disk_full_errnos_are_fatal():
+    """ENOSPC/EDQUOT/EROFS must classify FATAL, not TRANSIENT: retrying
+    a full disk burns the whole retry budget plus backoff wall-clock per
+    video — one full disk would otherwise become a slow fleet-wide hang
+    (ISSUE 9 satellite). A plain EIO stays TRANSIENT (NFS blips clear)."""
+    import errno
+    for code in ("ENOSPC", "EDQUOT", "EROFS"):
+        exc = OSError(getattr(errno, code), f"synthetic {code}")
+        assert faults.classify(exc) == faults.FATAL, code
+    assert faults.classify(OSError(errno.EIO, "blip")) == faults.TRANSIENT
+    assert faults.classify(OSError("errno-less oserror")) == faults.TRANSIENT
+
+
+def test_classify_forwarded_disk_full_is_fatal():
+    """The decode-worker protocol forwards child exceptions as strings
+    (utils/io.py, parallel/fanout.py); str(OSError) keeps the strerror,
+    and the forwarded form must reach the same FATAL verdict."""
+    fwd = RuntimeError("OSError: [Errno 28] No space left on device: 'x'")
+    assert faults.classify(fwd) == faults.FATAL
+    fwd = RuntimeError("shared decode failed for v.mp4: OSError: "
+                       "[Errno 122] Disk quota exceeded")
+    assert faults.classify(fwd) == faults.FATAL
+    # an injected-EIO forwarded error must NOT harden into FATAL
+    fwd = RuntimeError("OSError: [Errno 5] injected EIO at decode.read")
+    assert faults.classify(fwd) == faults.TRANSIENT
+
+
 def test_ladder_order():
     assert faults.demote("parallel") == "process"
     assert faults.demote("process") == "inline"

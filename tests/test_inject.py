@@ -1,0 +1,398 @@
+"""Deterministic fault-injection plane (utils/inject.py, ISSUE 9).
+
+Covers the plan grammar + launch validation, seeded determinism (same
+seed => same firing pattern, the replay contract), the
+zero-when-disarmed contract, and each site's behavioral semantics at
+the unit level: the sink write legs (no-litter under ENOSPC/torn/drop),
+cache store/lookup, the queue claim-skew and steal-staging-drop
+windows, and the heartbeat tick error accounting + freeze. The
+end-to-end composition (full CLI chaos runs audited by vft-audit) lives
+in tests/test_chaos.py; the auditor itself in tests/test_audit.py.
+"""
+import errno
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.utils import inject
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends injection-off (the module global must
+    never leak between tests — exactly the cli.py finally contract)."""
+    inject._set_active(None)
+    yield
+    inject._set_active(None)
+
+
+# ---------------------------------------------------------------------------
+# plan grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_plan_round_trip():
+    p = inject.parse_plan(
+        "seed=7;sink.fsync=enospc@n1;decode.read=eio@p0.05;"
+        "heartbeat.tick=freeze@after2;queue.claim=skew@every3")
+    assert p.seed == 7
+    assert set(p.rules) == {"sink.fsync", "decode.read", "heartbeat.tick",
+                            "queue.claim"}
+    assert p.rules["sink.fsync"].trigger == "n"
+    assert p.rules["decode.read"].value == pytest.approx(0.05)
+    assert p.rules["heartbeat.tick"].trigger == "after"
+    assert p.rules["queue.claim"].trigger == "every"
+
+
+def test_parse_plan_default_trigger_is_first_hit():
+    p = inject.parse_plan("seed=1;sink.rename=drop")
+    r = p.rules["sink.rename"]
+    assert r.should_fire(1) and not r.should_fire(2)
+
+
+def test_seed_clause_position_does_not_matter():
+    a = inject.parse_plan("seed=5;decode.read=eio@p0.4")
+    b = inject.parse_plan("decode.read=eio@p0.4;seed=5")
+    fa = [a.rules["decode.read"].should_fire(i) for i in range(1, 100)]
+    fb = [b.rules["decode.read"].should_fire(i) for i in range(1, 100)]
+    assert fa == fb
+
+
+@pytest.mark.parametrize("bad", [
+    "", "   ", "seed=1",                    # no site rules
+    "seed=x;sink.fsync=eio",                # bad seed
+    "bogus.site=eio",                       # unknown site
+    "sink.fsync=bogus",                     # unknown fault
+    "sink.fsync=eio@n0",                    # trigger needs n >= 1
+    "sink.fsync=eio@p0",                    # p in (0, 1]
+    "sink.fsync=eio@p1.5",
+    "sink.fsync=eio@sometimes",             # unknown trigger
+    "decode.read=torn",                     # torn is sink-only
+    "sink.fsync=skew",                      # skew is queue.claim-only
+    "no-equals-clause;sink.fsync=eio",
+])
+def test_parse_plan_rejects(bad):
+    with pytest.raises(ValueError):
+        inject.parse_plan(bad)
+
+
+def test_sanity_check_validates_inject_key(tmp_path, sample_video):
+    from video_features_tpu.config import load_config, sanity_check
+    base = dict(video_paths=[sample_video], output_path=str(tmp_path / "o"),
+                tmp_path=str(tmp_path / "t"), device="cpu")
+    ok = load_config("resnet", dict(base, inject="seed=1;sink.fsync=eio@n1"))
+    sanity_check(ok)  # parses cleanly
+    bad = load_config("resnet", dict(base, inject="sink.fsync=wat"))
+    with pytest.raises(ValueError, match="unknown fault"):
+        sanity_check(bad)
+    notstr = load_config("resnet", dict(base, inject=17))
+    with pytest.raises(ValueError, match="plan string"):
+        sanity_check(notstr)
+
+
+# ---------------------------------------------------------------------------
+# determinism + the replay contract
+# ---------------------------------------------------------------------------
+
+def test_same_seed_same_firing_pattern():
+    spec = "seed=3;decode.read=eio@p0.3"
+    runs = []
+    for _ in range(2):
+        plan = inject.parse_plan(spec)
+        fired = []
+        for i in range(200):
+            try:
+                fired.append(plan.check("decode.read", {}) is not None)
+            except OSError:
+                fired.append(True)
+        runs.append(fired)
+    assert runs[0] == runs[1], "same seed+spec must replay exactly"
+    assert any(runs[0]) and not all(runs[0])
+    other = inject.parse_plan("seed=4;decode.read=eio@p0.3")
+    fired4 = []
+    for i in range(200):
+        try:
+            fired4.append(other.check("decode.read", {}) is not None)
+        except OSError:
+            fired4.append(True)
+    assert fired4 != runs[0], "different seeds must differ"
+
+
+def test_per_site_streams_are_independent():
+    """Adding a rule for one site must not shift another site's draws —
+    otherwise narrowing a plan during triage changes the failure."""
+    solo = inject.parse_plan("seed=9;decode.read=eio@p0.25")
+    both = inject.parse_plan(
+        "seed=9;decode.read=eio@p0.25;heartbeat.tick=error@p0.5")
+    seq = [solo.rules["decode.read"].should_fire(i) for i in range(1, 300)]
+    seq2 = [both.rules["decode.read"].should_fire(i) for i in range(1, 300)]
+    assert seq == seq2
+
+
+def test_fire_disarmed_is_none_and_counts_nothing():
+    assert inject.active() is None
+    assert inject.fire("decode.read", video="v") is None
+    assert inject.fire("worker.kill") is None  # would SIGKILL if armed!
+
+
+def test_arm_for_run_env_overrides_config(monkeypatch):
+    monkeypatch.delenv("VFT_INJECT", raising=False)
+    plan = inject.arm_for_run("seed=1;sink.fsync=eio@n1")
+    assert plan is not None and plan.seed == 1
+    monkeypatch.setenv("VFT_INJECT", "seed=2;decode.read=eio@n1")
+    plan = inject.arm_for_run("seed=1;sink.fsync=eio@n1")
+    assert plan.seed == 2 and "decode.read" in plan.rules, \
+        "VFT_INJECT must override the config key (subprocess workers)"
+    monkeypatch.delenv("VFT_INJECT")
+    assert inject.arm_for_run(None) is None
+    assert inject.active() is None
+
+
+def test_fired_tally_and_summary():
+    plan = inject.parse_plan("seed=1;sink.fsync=eio@n2")
+    inject._set_active(plan)
+    assert inject.fire("sink.fsync") is None          # hit 1: no fire
+    with pytest.raises(OSError):
+        inject.fire("sink.fsync")                     # hit 2: fires
+    assert inject.fire("sink.fsync") is None          # hit 3: no fire
+    assert plan.hits["sink.fsync"] == 3
+    assert plan.fired["sink.fsync"] == 1
+    assert "sink.fsync:1/3" in plan.summary()
+
+
+# ---------------------------------------------------------------------------
+# sink legs: ENOSPC / torn / drop never litter, never tear
+# ---------------------------------------------------------------------------
+
+def _arm(spec):
+    plan = inject.parse_plan(spec)
+    inject._set_active(plan)
+    return plan
+
+
+def test_sink_fsync_enospc_no_litter_then_clean_retry(tmp_path):
+    from video_features_tpu.utils.sinks import _write_bytes_atomic
+    _arm("seed=1;sink.fsync=enospc@n1")
+    target = tmp_path / "x.bin"
+    with pytest.raises(OSError) as ei:
+        _write_bytes_atomic(str(target), b"payload")
+    assert ei.value.errno == errno.ENOSPC
+    assert not target.exists()
+    assert list(tmp_path.iterdir()) == [], \
+        "an injected ENOSPC at fsync must not leak the .tmp file"
+    _write_bytes_atomic(str(target), b"payload")  # hit 2: clean
+    assert target.read_bytes() == b"payload"
+
+
+def test_sink_torn_write_keeps_prior_artifact(tmp_path):
+    from video_features_tpu.utils.sinks import _write_bytes_atomic
+    target = tmp_path / "x.bin"
+    _write_bytes_atomic(str(target), b"generation-1")
+    _arm("seed=1;sink.tmp_write=torn@n1")
+    with pytest.raises(OSError) as ei:
+        _write_bytes_atomic(str(target), b"generation-2-much-longer")
+    assert ei.value.errno == errno.EIO
+    assert target.read_bytes() == b"generation-1", \
+        "a torn replacement write must leave the prior artifact intact"
+    assert [p.name for p in tmp_path.iterdir()] == ["x.bin"]
+
+
+def test_sink_rename_drop_is_retryable_transient(tmp_path):
+    from video_features_tpu.utils import faults
+    from video_features_tpu.utils.sinks import _write_bytes_atomic
+    _arm("seed=1;sink.rename=drop@n1")
+    target = tmp_path / "x.bin"
+    with pytest.raises(OSError) as ei:
+        _write_bytes_atomic(str(target), b"data")
+    assert faults.classify(ei.value) == faults.TRANSIENT
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_write_numpy_armed_path_byte_identical(tmp_path):
+    """Arming a plan reroutes write_numpy through the Python atomic path
+    (so the sink sites cover it); the bytes must equal the native
+    writer's — the inject-off-is-identical discipline."""
+    from video_features_tpu.utils.sinks import write_numpy
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    off = tmp_path / "off.npy"
+    write_numpy(str(off), arr)
+    _arm("seed=1;decode.read=eio@n999999")  # armed, but never fires
+    on = tmp_path / "on.npy"
+    write_numpy(str(on), arr)
+    assert off.read_bytes() == on.read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# cache sites: store failures raise, torn lookups drop-and-miss
+# ---------------------------------------------------------------------------
+
+def _mini_cache(tmp_path):
+    from video_features_tpu.cache import FeatureCache
+    video = tmp_path / "v.bin"
+    video.write_bytes(b"not really a video, but hashable content")
+    return FeatureCache(str(tmp_path / "store"), "resnet", "cfg", "wts"), \
+        str(video)
+
+
+def test_cache_store_fault_raises_and_leaves_no_entry(tmp_path):
+    cache, video = _mini_cache(tmp_path)
+    _arm("seed=1;cache.store=eio@n1")
+    with pytest.raises(OSError):
+        cache.store(video, {"resnet": np.ones((2, 4), np.float32)})
+    assert cache.lookup(video) is None
+    assert not list(Path(cache.root).rglob("*.pkl"))
+    cache.store(video, {"resnet": np.ones((2, 4), np.float32)})  # hit 2
+    assert cache.lookup(video) is not None
+
+
+def test_cache_lookup_torn_entry_dropped_never_served(tmp_path):
+    cache, video = _mini_cache(tmp_path)
+    feats = {"resnet": np.arange(8, dtype=np.float32)}
+    cache.store(video, feats)
+    entry = cache.entry_path(cache.key_for(video))
+    _arm("seed=1;cache.lookup=torn@n1")
+    assert cache.lookup(video) is None, \
+        "a torn entry must be a miss, never served"
+    assert not os.path.exists(entry), "the torn entry must be dropped"
+    got = cache.lookup(video)  # hit 2: entry gone -> plain miss
+    assert got is None
+    cache.store(video, feats)
+    got = cache.lookup(video)
+    assert got is not None and np.array_equal(got["resnet"],
+                                              feats["resnet"])
+
+
+def test_cache_store_failure_contained_by_extractor(tmp_path, sample_video):
+    """A cache-store failure after the sink landed must not fail the
+    video: the store is an optimization (extractors/base.py contains
+    it), and the artifacts are already durable."""
+    from video_features_tpu.cli import main
+    out = tmp_path / "out"
+    main(["feature_type=resnet", "model_name=resnet18", "device=cpu",
+          "allow_random_weights=true", "on_extraction=save_numpy",
+          "extraction_total=4", "batch_size=8",
+          "cache=true", f"cache_dir={tmp_path / 'cachedir'}",
+          "inject=seed=1;cache.store=eio@n1",
+          f"output_path={out}", f"tmp_path={tmp_path / 'tmp'}",
+          f"video_paths=[{sample_video}]"])
+    arts = list(out.rglob("*_resnet.npy"))
+    assert len(arts) == 1, "the video must complete despite the store fault"
+    journal = list(out.rglob("_failures.jsonl"))
+    assert not journal, "a contained store failure must not journal"
+
+
+# ---------------------------------------------------------------------------
+# queue sites: skewed leases get stolen; a dropped steal is swept back
+# ---------------------------------------------------------------------------
+
+def _mk_queue(tmp_path, host, clock, lease_s=10.0):
+    from video_features_tpu.parallel.queue import WorkQueue
+    return WorkQueue(str(tmp_path), host_id=host, run_id=f"r-{host}",
+                     lease_s=lease_s, clock=clock)
+
+
+def _write_heartbeat(tmp_path, host, t, final=False, interval=1.0):
+    from video_features_tpu.telemetry.heartbeat import heartbeat_filename
+    from video_features_tpu.telemetry.jsonl import write_json_atomic
+    write_json_atomic(os.path.join(str(tmp_path), heartbeat_filename(host)),
+                      {"host_id": host, "time": t, "interval_s": interval,
+                       "final": final})
+
+
+def test_queue_claim_skew_makes_lease_immediately_stealable(tmp_path):
+    now = [1000.0]
+    qa = _mk_queue(tmp_path, "hostA", lambda: now[0])
+    qb = _mk_queue(tmp_path, "hostB", lambda: now[0])
+    qa.seed(["v0.mp4"])
+    _write_heartbeat(tmp_path, "hostA", now[0])  # A looks alive
+    _write_heartbeat(tmp_path, "hostB", now[0])
+    _arm("seed=1;queue.claim=skew@n1")
+    rec = qa.claim_next()
+    assert rec is not None
+    assert float(rec["deadline"]) < now[0], "skew must stamp an " \
+        "already-expired deadline"
+    inject._set_active(None)
+    assert qb.reclaim_expired() == 1, \
+        "a skew-expired lease must be stealable despite a live owner"
+    stolen = qb.claim_next()
+    assert stolen is not None and stolen["reclaims"] == 1
+    assert stolen["last_owner"] == "hostA"
+
+
+def test_queue_steal_staging_drop_recovered_by_sweep(tmp_path):
+    now = [1000.0]
+    qa = _mk_queue(tmp_path, "hostA", lambda: now[0], lease_s=10.0)
+    qb = _mk_queue(tmp_path, "hostB", lambda: now[0], lease_s=10.0)
+    qa.seed(["v0.mp4"])
+    _write_heartbeat(tmp_path, "hostB", now[0])
+    rec = qa.claim_next()
+    assert rec is not None
+    now[0] += 100.0  # lease long expired; hostA heartbeat silent
+    _arm("seed=1;queue.steal_staging=drop@n1")
+    assert qb.reclaim_expired() == 0, "the stealer 'died' mid-move"
+    inject._set_active(None)
+    staging = list(Path(qb.root, ".staging").glob("*.json"))
+    assert len(staging) == 1, "the item must sit in .staging, not vanish"
+    # age the orphan past STAGING_ORPHAN_LEASES * lease_s ON THE QUEUE'S
+    # (injected) clock — the sweep compares its clock to file mtimes
+    os.utime(staging[0], (now[0] - 100.0, now[0] - 100.0))
+    assert qb.reclaim_expired() == 1, "the staging sweep must recover it"
+    got = qb.claim_next()
+    assert got is not None and got.get("video") == "v0.mp4"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat site: tick errors counted + surfaced; freeze looks dead
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_tick_errors_counted_and_surfaced(tmp_path):
+    from video_features_tpu.telemetry.recorder import TelemetryRecorder
+    _arm("seed=1;heartbeat.tick=error@n1")
+    rec = TelemetryRecorder(str(tmp_path), interval_s=0.03,
+                            host_id="tickhost").start()
+    try:
+        deadline = time.time() + 5.0
+        while rec._hb.tick_errors_total < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        # wait for the NEXT (successful) tick to surface the error
+        while time.time() < deadline:
+            hb = json.loads(Path(rec.heartbeat_path).read_text())
+            if hb.get("tick_errors"):
+                break
+            time.sleep(0.01)
+    finally:
+        rec.close()
+    assert rec._hb.tick_errors_total == 1
+    assert "injected fault at heartbeat.tick" in rec._hb.last_tick_error
+    hb = json.loads(Path(rec.heartbeat_path).read_text())
+    assert hb["tick_errors"] == 1
+    assert "heartbeat.tick" in hb["last_tick_error"]
+    series = [s for s in rec.registry.to_dict()["series"]
+              if s["name"] == "vft_heartbeat_tick_errors_total"]
+    assert series and series[0]["value"] == 1
+
+
+def test_heartbeat_freeze_skips_ticks_silently():
+    from video_features_tpu.telemetry.heartbeat import HeartbeatThread
+    ticks = [0]
+
+    def tick():
+        ticks[0] += 1
+
+    _arm("seed=1;heartbeat.tick=freeze@after1")
+    hb = HeartbeatThread(tick, 0.02)
+    hb.start()
+    try:
+        deadline = time.time() + 5.0
+        while hb.frozen_ticks < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        hb.stop()
+    assert ticks[0] == 1, "only the pre-freeze tick may run"
+    assert hb.frozen_ticks >= 3
+    assert hb.tick_errors_total == 0, "freeze is silence, not an error"

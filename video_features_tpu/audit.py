@@ -1,0 +1,498 @@
+"""vft-audit: the run-invariant auditor — prove the durability contracts.
+
+Reads a finished *or killed* output directory and asserts, as one
+PASS/FAIL verdict, the cross-subsystem invariants that PRs 1-8's
+durability machinery promises to hold under ANY interleaving of crashes
+(see docs/chaos.md for the full list with rationale):
+
+  1. **no ``.tmp`` litter** — every writer in the tree uses
+     temp+fsync+rename with unlink-on-failure (utils/sinks.py,
+     telemetry/jsonl.py, serve.py); a ``.tmp`` file on disk means a
+     writer leaked its scratch.
+  2. **at most one torn record per jsonl file, and only at the tail** —
+     O_APPEND single-write records (telemetry/jsonl.py) can tear only
+     the last line (a SIGKILL mid-write); a corrupt line mid-file means
+     interleaved or non-atomic appends.
+  3. **done markers => artifacts** — every fleet-queue ``done/`` marker
+     with status done/skipped has loadable artifacts for its video;
+     status error has a failure-journal record explaining it.
+  4. **no orphaned claims for finalized hosts** — a host that wrote a
+     *final* heartbeat exited gracefully and must have released or
+     completed every claim (cli.py ``release_all``); claims whose owner
+     is merely stale/missing are *recoverable* (lease steal) and only
+     noted.
+  5. **nothing stranded** — with ``--expect-complete`` (a drained run),
+     ``pending/``/``claimed/`` must be empty; ``.staging/`` entries
+     whose item has no done marker are violations once no live host
+     remains to sweep them.
+  6. **every quarantined item has a POISON journal record** — the queue
+     quarantine (parallel/queue.py) and the journal (utils/faults.py)
+     must agree, or ``retry_failed=true`` cannot lift it.
+  7. **health digests re-verify** — each ``_health.jsonl`` record's
+     quantization-tolerant signature (telemetry/health.py) is recomputed
+     from the artifact on disk; a mismatch means the bytes rotted or a
+     non-atomic writer tore them. A record with NaN/Inf counts must have
+     NO artifact (the health gate refuses those writes).
+  8. **artifact spans re-verify** — every ``artifact`` span event
+     (utils/sinks.py records bytes+sha256 of exactly what was renamed
+     into place) must match the file on disk, byte for byte.
+  9. **cache entries re-verify** — with ``--cache-dir`` (or a manifest
+     that names one), every store entry must load, carry the current
+     schema, and match its stored per-tensor signatures
+     (verify-before-trust, cache.py).
+
+Violations are states the machinery PROMISES cannot happen no matter
+where a worker died; notes are recoverable in-flight states a killed
+run legitimately leaves behind. Exit 0 on PASS (no violations), 1 on
+FAIL — tests/test_chaos.py's seeded matrix and the
+``scripts/check_inject_smoke.py`` CI gate both end every injected run
+with this verdict.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+ARTIFACT_EXTS = (".npy", ".pkl")
+
+
+class Audit:
+    """One audit pass over an output root; collects violations/notes."""
+
+    def __init__(self, root: str, *, cache_dir: Optional[str] = None,
+                 expect_complete: bool = False) -> None:
+        self.root = Path(root)
+        self.cache_dir = cache_dir
+        self.expect_complete = bool(expect_complete)
+        self.violations: List[str] = []
+        self.notes: List[str] = []
+        self.stats: Dict[str, int] = {}
+        self._journal_files: List[Path] = []
+
+    def violation(self, msg: str) -> None:
+        self.violations.append(msg)
+
+    def note(self, msg: str) -> None:
+        self.notes.append(msg)
+
+    # -- helpers ------------------------------------------------------------
+    def _rel(self, p: Path) -> str:
+        try:
+            return str(p.relative_to(self.root))
+        except ValueError:
+            return str(p)
+
+    def _load_artifact(self, path: Path):
+        import numpy as np
+        if path.suffix == ".npy":
+            return np.load(path, allow_pickle=False)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def _journal_records(self) -> Dict[str, dict]:
+        """Latest failure-journal record per video, across every
+        ``_failures.jsonl`` under the root (multi-family runs keep one
+        per family dir; last record wins within a file, any file's
+        POISON counts for invariant 6)."""
+        from .telemetry.jsonl import read_jsonl
+        out: Dict[str, dict] = {}
+        self._journal_files = sorted(self.root.rglob("_failures.jsonl"))
+        for path in self._journal_files:
+            for rec in read_jsonl(path):
+                v = rec.get("video")
+                if v is not None:
+                    # POISON/terminal records win over later RESOLVED only
+                    # for invariant 6's purposes? No: mirror FailureJournal
+                    # (last record wins); RESOLVED lifting is legitimate
+                    out[str(v)] = rec
+        return out
+
+    # -- invariant 1: no .tmp litter ----------------------------------------
+    def check_tmp_litter(self) -> None:
+        tmps = sorted(self.root.rglob("*.tmp"))
+        self.stats["tmp_files"] = len(tmps)
+        for p in tmps:
+            self.violation(
+                f"tmp litter: {self._rel(p)} — a temp+rename writer leaked "
+                "its scratch file (missing unlink-on-failure)")
+
+    # -- invariant 2: jsonl torn tails only ---------------------------------
+    def check_jsonl(self) -> None:
+        files = sorted(self.root.rglob("*.jsonl"))
+        self.stats["jsonl_files"] = len(files)
+        for path in files:
+            try:
+                raw_lines = path.read_bytes().split(b"\n")
+            except OSError as e:
+                self.violation(f"{self._rel(path)}: unreadable ({e})")
+                continue
+            # a trailing newline yields one empty final element; drop it
+            if raw_lines and raw_lines[-1] == b"":
+                raw_lines.pop()
+            bad = []
+            for i, raw in enumerate(raw_lines):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    json.loads(raw.decode("utf-8", errors="replace"))
+                except ValueError:
+                    bad.append(i)
+            for i in bad:
+                if i == len(raw_lines) - 1:
+                    self.note(f"{self._rel(path)}: torn trailing record "
+                              "(healable: the next append repairs it)")
+                else:
+                    self.violation(
+                        f"{self._rel(path)}: corrupt record at line {i + 1} "
+                        f"of {len(raw_lines)} — mid-file tears cannot happen "
+                        "under single-write O_APPEND records")
+
+    # -- invariants 3-6: fleet queue state ----------------------------------
+    def _read_json(self, path: Path) -> Optional[dict]:
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _heartbeats(self, out_root: Path) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for p in sorted(out_root.glob("_heartbeat_*.json")):
+            hb = self._read_json(p)
+            if hb is not None:
+                out[str(hb.get("host_id") or p.stem)] = hb
+        return out
+
+    def check_queue(self, journal: Dict[str, dict]) -> None:
+        from .telemetry.heartbeat import heartbeat_filename
+        queues = sorted(p for p in self.root.rglob("_queue")
+                        if p.is_dir())
+        self.stats["queues"] = len(queues)
+        for q in queues:
+            out_root = q.parent
+            hbs = self._heartbeats(out_root)
+            all_final = bool(hbs) and all(hb.get("final") for hb in
+                                          hbs.values())
+            done: Dict[str, dict] = {}
+            for p in sorted((q / "done").glob("*.json")):
+                rec = self._read_json(p)
+                if rec is None:
+                    self.violation(f"{self._rel(p)}: unparseable done "
+                                   "marker — done markers are single-write "
+                                   "O_EXCL files and cannot tear")
+                    continue
+                done[p.stem] = rec
+            self.stats["done_markers"] = \
+                self.stats.get("done_markers", 0) + len(done)
+
+            # 3: done/skipped => artifacts; error => journal record
+            for iid, rec in done.items():
+                video = rec.get("video")
+                status = rec.get("status")
+                stem = Path(str(video)).stem if video else iid
+                arts = [p for ext in ARTIFACT_EXTS
+                        for p in out_root.rglob(f"{stem}_*{ext}")]
+                if status in ("done", "skipped"):
+                    if not arts:
+                        self.violation(
+                            f"done marker {iid} (status={status}) has no "
+                            f"artifact for {stem!r} under "
+                            f"{self._rel(out_root)} — completion published "
+                            "before the sink's atomic rename landed")
+                        continue
+                    for a in arts:
+                        try:
+                            self._load_artifact(a)
+                        except Exception as e:
+                            self.violation(
+                                f"done marker {iid}: artifact "
+                                f"{self._rel(a)} does not load "
+                                f"({type(e).__name__}: {e}) — atomic sinks "
+                                "cannot leave torn outputs")
+                elif status == "error":
+                    if journal and str(video) not in journal:
+                        self.violation(
+                            f"done marker {iid} reports status=error but "
+                            f"no failure journal records {video!r} — every "
+                            "terminal failure must be journaled")
+                    if not self._journal_files:
+                        self.note(f"done marker {iid} status=error with no "
+                                  "journal present (print sink?)")
+
+            # 4: claims vs owner heartbeats
+            claimed_root = q / "claimed"
+            if claimed_root.is_dir():
+                for host_dir in sorted(claimed_root.iterdir()):
+                    if not host_dir.is_dir():
+                        continue
+                    claims = sorted(host_dir.glob("*.json"))
+                    if not claims:
+                        continue
+                    hb = self._read_json(
+                        out_root / heartbeat_filename(host_dir.name))
+                    owner = host_dir.name
+                    if hb is not None and hb.get("final"):
+                        for c in claims:
+                            self.violation(
+                                f"orphaned claim {self._rel(c)}: owner "
+                                f"{owner} wrote a FINAL heartbeat — a "
+                                "graceful exit must release or complete "
+                                "every claim (cli.py release_all)")
+                    elif self.expect_complete:
+                        for c in claims:
+                            self.violation(
+                                f"leftover claim {self._rel(c)} after a "
+                                f"supposedly drained run (owner {owner})")
+                    else:
+                        self.note(f"{len(claims)} in-flight claim(s) held "
+                                  f"by {owner} (owner not finalized — "
+                                  "recoverable by lease steal)")
+                    for c in claims:
+                        if c.stem in done:
+                            self.note(f"claim {self._rel(c)} duplicates a "
+                                      "done marker (recoverable: claimants "
+                                      "discard against done)")
+
+            # 5: pending / staging strandedness
+            pending = sorted((q / "pending").glob("*.json"))
+            if pending and self.expect_complete:
+                for p in pending:
+                    self.violation(f"pending item {self._rel(p)} after a "
+                                   "supposedly drained run")
+            for p in pending:
+                if p.stem in done:
+                    self.note(f"pending item {self._rel(p)} duplicates a "
+                              "done marker (recoverable: discarded at "
+                              "next claim)")
+            staging = sorted((q / ".staging").glob("*.json"))
+            for p in staging:
+                rec = self._read_json(p) or {}
+                iid = str(rec.get("id") or "")
+                if iid and iid in done:
+                    self.note(f"staging leftover {self._rel(p)} for a done "
+                              "item (dead weight; swept later)")
+                elif all_final or self.expect_complete:
+                    self.violation(
+                        f"item stranded in staging: {self._rel(p)} has no "
+                        "done marker and no live host remains to sweep it "
+                        "back to pending — the work is lost")
+                else:
+                    self.note(f"staging in-flight: {self._rel(p)} "
+                              "(recoverable by the orphan sweep)")
+
+            # 6: quarantined => POISON journal record
+            for p in sorted((q / "quarantined").glob("*.json")):
+                rec = self._read_json(p)
+                video = (rec or {}).get("video")
+                jrec = journal.get(str(video)) if video else None
+                if jrec is None or jrec.get("category") != "POISON":
+                    self.violation(
+                        f"quarantined item {self._rel(p)} "
+                        f"(video={video!r}) has no POISON record in any "
+                        "failure journal — retry_failed=true could never "
+                        "lift it and restarted workers would re-dispatch")
+
+    # -- invariant 7: health digests re-verify -------------------------------
+    def check_health(self) -> None:
+        import numpy as np
+        from .telemetry.health import HEALTH_FILENAME, content_signature
+        from .telemetry.jsonl import read_jsonl
+        n_checked = 0
+        for hpath in sorted(self.root.rglob(HEALTH_FILENAME)):
+            fam_dir = hpath.parent
+            latest: Dict[Tuple[str, str], dict] = {}
+            for rec in read_jsonl(hpath):
+                latest[(str(rec.get("video")), str(rec.get("key")))] = rec
+            for (video, key), rec in sorted(latest.items()):
+                stem = Path(video).stem
+                art = None
+                for ext in ARTIFACT_EXTS:
+                    cand = fam_dir / f"{stem}_{key}{ext}"
+                    if cand.exists():
+                        art = cand
+                        break
+                nonfinite = int(rec.get("nan") or 0) + int(rec.get("inf")
+                                                           or 0)
+                if art is None:
+                    if nonfinite == 0:
+                        self.note(f"health digest for ({stem}, {key}) has "
+                                  f"no artifact in {self._rel(fam_dir)} "
+                                  "(print sink, or killed pre-write — "
+                                  "digests are taken before the sink)")
+                    continue
+                if nonfinite:
+                    self.violation(
+                        f"{self._rel(art)}: health recorded {rec.get('nan')}"
+                        f" NaN / {rec.get('inf')} Inf for this tensor, yet "
+                        "an artifact exists — the non-finite gate must "
+                        "refuse the write (telemetry/health.py)")
+                    continue
+                try:
+                    value = self._load_artifact(art)
+                except Exception as e:
+                    self.violation(f"{self._rel(art)}: does not load "
+                                   f"({type(e).__name__}: {e})")
+                    continue
+                got = content_signature(np.asarray(value))
+                if got != rec.get("sig"):
+                    self.violation(
+                        f"{self._rel(art)}: content signature mismatch vs "
+                        "its _health.jsonl record — the bytes on disk are "
+                        "not the bytes that were digested (rot, tamper, "
+                        "or a non-atomic writer)")
+                n_checked += 1
+        self.stats["health_verified"] = n_checked
+
+    # -- invariant 8: artifact span shas re-verify ---------------------------
+    def check_artifact_spans(self) -> None:
+        import hashlib
+        from .telemetry.jsonl import read_jsonl
+        latest: Dict[str, dict] = {}
+        for spath in sorted(self.root.rglob("_telemetry.jsonl")):
+            for rec in read_jsonl(spath):
+                for ev in rec.get("events") or []:
+                    if ev.get("kind") == "artifact" and ev.get("file"):
+                        latest[str(ev["file"])] = ev
+        n_checked = 0
+        for fname, ev in sorted(latest.items()):
+            matches = sorted(self.root.rglob(fname))
+            if not matches:
+                self.violation(
+                    f"artifact {fname} recorded in a span (bytes="
+                    f"{ev.get('bytes')}) but absent on disk — spans emit "
+                    "after the atomic rename, so the file must exist")
+                continue
+            for path in matches:
+                try:
+                    data = path.read_bytes()
+                except OSError as e:
+                    self.violation(f"{self._rel(path)}: unreadable ({e})")
+                    continue
+                if ev.get("bytes") is not None and \
+                        len(data) != int(ev["bytes"]):
+                    self.violation(
+                        f"{self._rel(path)}: {len(data)} bytes on disk vs "
+                        f"{ev['bytes']} recorded — truncated or replaced "
+                        "by a non-identical writer")
+                    continue
+                if ev.get("sha256") and \
+                        hashlib.sha256(data).hexdigest() != ev["sha256"]:
+                    self.violation(
+                        f"{self._rel(path)}: sha256 differs from the span "
+                        "record of what was renamed into place")
+                n_checked += 1
+        self.stats["artifact_spans_verified"] = n_checked
+
+    # -- invariant 9: cache entries re-verify --------------------------------
+    def _discover_cache_dir(self) -> Optional[str]:
+        if self.cache_dir:
+            return self.cache_dir
+        for mpath in sorted(self.root.rglob("_run.json")):
+            m = self._read_json(mpath) or {}
+            cfgs: List[dict] = []
+            rc = m.get("run_config") or {}
+            cfgs.append(rc)
+            cfgs.extend((rc.get("families") or {}).values())
+            for cfg in cfgs:
+                if isinstance(cfg, dict) and cfg.get("cache") and \
+                        cfg.get("cache_dir"):
+                    return str(cfg["cache_dir"])
+        return None
+
+    def check_cache(self) -> None:
+        import numpy as np
+        from .cache import SCHEMA_VERSION
+        from .telemetry.health import content_signature
+        root = self._discover_cache_dir()
+        if root is None:
+            return
+        if not os.path.isdir(root):
+            self.note(f"cache dir {root} does not exist (nothing stored)")
+            return
+        n_checked = 0
+        for path in sorted(Path(root).rglob("*.pkl")):
+            try:
+                with open(path, "rb") as f:
+                    entry = pickle.load(f)
+                if entry.get("schema") != SCHEMA_VERSION:
+                    raise ValueError(f"schema {entry.get('schema')!r}")
+                for k, arr in entry["feats"].items():
+                    if content_signature(np.asarray(arr)) != \
+                            entry["sigs"].get(k):
+                        raise ValueError(f"signature mismatch for {k!r}")
+            except Exception as e:
+                self.violation(
+                    f"cache entry {path} fails re-verification "
+                    f"({type(e).__name__}: {e}) — atomic entry writes + "
+                    "verify-before-trust promise this never persists")
+                continue
+            n_checked += 1
+        self.stats["cache_entries_verified"] = n_checked
+
+    # -- driver --------------------------------------------------------------
+    def run(self) -> bool:
+        if not self.root.is_dir():
+            self.violation(f"{self.root}: not a directory")
+            return False
+        journal = self._journal_records()
+        self.stats["journal_records"] = len(journal)
+        self.check_tmp_litter()
+        self.check_jsonl()
+        self.check_queue(journal)
+        self.check_health()
+        self.check_artifact_spans()
+        self.check_cache()
+        return not self.violations
+
+
+def audit_run(root: str, *, cache_dir: Optional[str] = None,
+              expect_complete: bool = False
+              ) -> Tuple[bool, List[str], List[str]]:
+    """Library entry point (tests/test_chaos.py): returns
+    ``(ok, violations, notes)``."""
+    a = Audit(root, cache_dir=cache_dir, expect_complete=expect_complete)
+    ok = a.run()
+    return ok, a.violations, a.notes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vft-audit",
+        description="Audit a (finished or killed) extraction output "
+                    "directory against the cross-subsystem durability "
+                    "invariants (docs/chaos.md).")
+    ap.add_argument("root", help="output directory to audit (the CLI's "
+                                 "output_path, or the root above it for "
+                                 "multi-family runs)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="feature-cache root to re-verify (default: "
+                         "discovered from _run.json manifests)")
+    ap.add_argument("--expect-complete", action="store_true",
+                    help="the run claims to have drained: leftover "
+                         "pending/claimed queue entries become violations")
+    args = ap.parse_args(argv)
+    a = Audit(args.root, cache_dir=args.cache_dir,
+              expect_complete=args.expect_complete)
+    ok = a.run()
+    print(f"vft-audit: {args.root}")
+    stat_line = ", ".join(f"{k}={v}" for k, v in sorted(a.stats.items()))
+    if stat_line:
+        print(f"  checked: {stat_line}")
+    for v in a.violations:
+        print(f"  VIOLATION: {v}")
+    for n in a.notes:
+        print(f"  note: {n}")
+    print(f"AUDIT: {'PASS' if ok else 'FAIL'} "
+          f"({len(a.violations)} violation(s), {len(a.notes)} note(s))")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

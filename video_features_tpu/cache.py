@@ -80,8 +80,14 @@ NON_SEMANTIC_KEYS = frozenset({
     "profile_trace_dir", "compilation_cache_dir",
     "retry_attempts", "retry_backoff_s", "video_deadline_s",
     "retry_failed",
+    # fleet scheduling (parallel/queue.py) moves work between hosts; it
+    # cannot change what any (video, config, weights) triple computes
+    "fleet", "fleet_lease_s", "fleet_max_reclaims", "fleet_canary",
     # the cache's own knobs must not key the cache
     "cache", "cache_dir",
+    # chaos-injection plans perturb scheduling/IO, never feature values
+    # (a fault either recovers bit-identically or fails the video)
+    "inject",
     # serve-mode knobs (serve.py): spool plumbing, not feature values
     "spool_dir", "serve_max_pending", "serve_poll_interval_s",
     "serve_idle_exit_s", "serve_max_requests", "serve_workers",
@@ -274,6 +280,7 @@ class FeatureCache:
         reported as a miss — corrupted bytes are never served."""
         from .telemetry import trace
         from .telemetry.health import content_signature
+        from .utils import inject
 
         with trace.span("cache.lookup", video=str(video_path),
                         family=self.family):
@@ -282,6 +289,13 @@ class FeatureCache:
             if not os.path.exists(path):
                 return None
             try:
+                fault = inject.fire("cache.lookup", video=str(video_path),
+                                    key=key[:12])
+                if fault is not None and fault.kind == "torn":
+                    # bit rot / a torn pre-atomic-writer entry: truncate
+                    # the stored bytes so verify-before-trust must catch it
+                    with open(path, "r+b") as f:
+                        f.truncate(max(1, os.path.getsize(path) // 2))
                 with open(path, "rb") as f:
                     entry = pickle.load(f)
                 feats = entry["feats"]
@@ -318,10 +332,13 @@ class FeatureCache:
         discipline) with per-key content signatures; returns the key."""
         from .telemetry import trace
         from .telemetry.health import content_signature
+        from .utils import inject
         from .utils.sinks import _write_bytes_atomic
 
         with trace.span("cache.store", video=str(video_path),
                         family=self.family):
+            inject.fire("cache.store", video=str(video_path),
+                        family=self.family)
             key = self.key_for(video_path)
             arrays = {k: np.asarray(v) for k, v in feats.items()}
             entry = {

@@ -140,6 +140,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     if verbose:
         print(args.to_yaml())
 
+    # Deterministic fault injection (inject=, utils/inject.py): seeded,
+    # replayable faults at named durability sites — chaos testing only.
+    # VFT_INJECT overrides the config key (and armed subprocess workers
+    # at import). Off (the default): every site is one global read.
+    from .utils import inject
+    inject_plan = inject.arm_for_run(args.get("inject"))
+    if inject_plan is not None:
+        print(f"inject: armed plan {inject_plan.spec!r} "
+              f"(seed={inject_plan.seed}; docs/chaos.md — replay by "
+              "re-running with this exact inject= string)")
+
     if multi_mode:
         from .extractors.multi import MultiExtractor
         extractor = None
@@ -439,6 +450,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             # likewise in the finally: an aborted run's partial timeline is
             # still a complete, loadable trace file (atomic temp+rename)
             tracer.close()
+        if inject_plan is not None:
+            # the chaos run's record of exactly what it injected (the
+            # counters land in the manifest metrics dump too)
+            print(inject_plan.summary())
+        inject.disarm()  # in-process callers must not inherit the plan
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())

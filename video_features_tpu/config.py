@@ -378,6 +378,19 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
                          "(gate joining hosts on a re-extracted slice, "
                          "docs/fleet.md)")
 
+    # fault-injection plan (utils/inject.py): the full plan grammar is
+    # parsed at launch, so a typo'd site/fault/trigger fails HERE with
+    # the offending clause named — never silently runs a chaos-free
+    # "chaos" run (docs/chaos.md)
+    inj = args.get("inject")
+    if inj is not None:
+        if not isinstance(inj, str):
+            raise ValueError(
+                f"inject={inj!r}: expected a plan string like "
+                "'seed=1;sink.fsync=enospc@n1' or null (docs/chaos.md)")
+        from .utils.inject import parse_plan
+        parse_plan(inj)  # raises ValueError naming the bad clause
+
     # resize=auto|host|device (extractors/base.py _resolve_resize_mode):
     # 'auto' (the default) picks 'device' for save sinks and 'host' for
     # print/show_pred and for families without a fused device resize

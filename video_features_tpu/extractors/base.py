@@ -271,8 +271,19 @@ class BaseExtractor:
         if cache is not None:
             # store AFTER the sink path: the health gate (NaN/Inf ->
             # POISON) and any sink failure must keep bad features out of
-            # the store exactly as they keep them off disk
-            cache.store(video_path, feats)
+            # the store exactly as they keep them off disk. A store
+            # FAILURE, though, is contained: the artifacts are already
+            # durable, and failing (or retrying) the whole video over a
+            # cache write would turn an optimization into a liability —
+            # the atomic entry write guarantees no torn entry was left
+            try:
+                cache.store(video_path, feats)
+            except Exception as e:
+                telemetry.inc("vft_cache_store_failures_total",
+                              family=str(self.feature_type))
+                print(f"cache: store failed for {video_path} "
+                      f"({type(e).__name__}: {e}) — features are on disk, "
+                      "entry skipped")
         return feats
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:

@@ -540,7 +540,14 @@ class FrameBus:
                           f"{len(s.index_map)} resampled frames.")
                 self._finish_sub(s, emitted[s.family])
         except BaseException as e:
+            # the forwarded string keeps the exception's name AND message
+            # (str(OSError) includes the strerror), so the subscribers'
+            # classify() sees the same POISON/FATAL markers an inline
+            # failure would — an injected ENOSPC inside the bus must not
+            # soften into a retried TRANSIENT on the family side
+            # (utils/faults.py _FATAL_MARKERS; utils/inject.py)
             msg = f"{type(e).__name__}: {e}"
+            telemetry.inc("vft_fanout_decode_errors_total")
             for s in subs:
                 if s.family in finished:
                     continue

@@ -75,6 +75,7 @@ from .. import telemetry
 from ..telemetry import trace
 from ..telemetry.heartbeat import STALL_INTERVALS, heartbeat_filename
 from ..telemetry.jsonl import write_json_atomic
+from ..utils import inject
 
 QUEUE_DIRNAME = "_queue"
 PENDING, CLAIMED, DONE, QUARANTINED = ("pending", "claimed", "done",
@@ -215,6 +216,13 @@ class WorkQueue:
             dst = os.path.join(self.host_dir, name)
             with trace.span("fleet.claim", item=name[:-len(".json")]):
                 try:
+                    # chaos hooks (utils/inject.py): `queue.claim=eio`
+                    # fails the rename like a lost race; `skew` stamps an
+                    # already-expired lease (a claimant whose clock — or
+                    # whose renewals — lag the fleet's), making the claim
+                    # immediately stealable while this host still works it
+                    fault = inject.fire("queue.claim",
+                                        item=name[:-len(".json")])
                     os.rename(src, dst)
                 except OSError:
                     continue  # another host won this item; try the next
@@ -234,9 +242,12 @@ class WorkQueue:
                 stolen = int(rec.get("reclaims", 0)) > 0 and \
                     rec.get("last_owner") not in (None, self.host_id)
                 now = self.clock()
+                deadline = now + self.lease_s
+                if fault is not None and fault.kind == "skew":
+                    deadline = now - self.lease_s  # already expired
                 rec.update(host_id=self.host_id, run_id=self.run_id,
                            claim_time=round(now, 3),
-                           deadline=round(now + self.lease_s, 3))
+                           deadline=round(deadline, 3))
                 write_json_atomic(dst, rec)
             with self._lock:
                 self._active[iid] = rec
@@ -341,6 +352,13 @@ class WorkQueue:
             os.rename(claimed_path, staging)
         except OSError:
             return False  # another stealer (or the owner's unlink) won
+        # chaos hook: the stealer "dies" exactly between the two renames
+        # (`drop` abandons the item in .staging/, which ONLY the orphan
+        # sweep can recover; `kill` is the real SIGKILL for subprocess
+        # chaos runs) — the narrowest window in the steal protocol
+        fault = inject.fire("queue.steal_staging", item=iid)
+        if fault is not None and fault.kind == "drop":
+            return False
         prev_owner = rec.get("host_id")
         reclaims = int(rec.get("reclaims", 0)) + (1 if bump else 0)
         rec = {"schema": ITEM_SCHEMA, "id": iid, "video": rec.get("video"),

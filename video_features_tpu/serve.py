@@ -117,11 +117,22 @@ def submit_request(spool_dir: str, video_paths: List[str],
            "time": round(time.time(), 3)}
     final = os.path.join(spool_dir, REQUESTS_DIR, f"{rid}.json")
     tmp = os.path.join(spool_dir, f".{rid}.json.tmp")
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(req, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, final)
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(req, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    except BaseException:
+        # unlink-on-failure, the sink discipline (utils/sinks.py): a raise
+        # between the temp write and the rename (ENOSPC at fsync, a dying
+        # client) must not litter the spool with .tmp files forever —
+        # vft-audit's no-tmp-litter invariant covers spools too
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return rid
 
 
@@ -385,12 +396,17 @@ class ServeLoop:
             names = [n for n in os.listdir(req_dir) if n.endswith(".json")]
         except OSError:
             return None
+        from .utils import inject
         for name in sorted(
                 names,
                 key=lambda n: self._mtime(os.path.join(req_dir, n))):
             src = os.path.join(req_dir, name)
             dst = os.path.join(self.claim_dir, name)
             try:
+                # chaos hook (utils/inject.py `spool.claim`): a failed
+                # claim rename looks exactly like a lost race — the
+                # request stays spooled for the next pass/server
+                inject.fire("spool.claim", request=name[:-len(".json")])
                 os.rename(src, dst)
                 return dst
             except OSError:
@@ -620,6 +636,12 @@ def serve_main(argv: Optional[List[str]] = None) -> None:
         out_root = str(args.output_path)
     _enable_compilation_cache(args)
 
+    # fault-injection plan (utils/inject.py): armed for the server's
+    # lifetime; VFT_INJECT overrides the config key (chaos harnesses
+    # launch real server processes with the env var)
+    from .utils import inject
+    inject_plan = inject.arm_for_run(args.get("inject"))
+
     loop = ServeLoop(args, per_family=per_family, out_root=out_root)
     # SIGTERM/SIGINT: finish in-flight requests, final heartbeat, exit 143
     if threading.current_thread() is threading.main_thread():
@@ -627,7 +649,12 @@ def serve_main(argv: Optional[List[str]] = None) -> None:
             print("vft-serve: SIGTERM — draining in-flight requests")
             loop.stop()
         signal.signal(signal.SIGTERM, _on_term)
-    rc = loop.run()
+    try:
+        rc = loop.run()
+    finally:
+        if inject_plan is not None:
+            print(inject_plan.summary())
+        inject.disarm()
     if rc:
         raise SystemExit(rc)
 

@@ -67,6 +67,17 @@ class HeartbeatThread:
     ``Event.wait(interval)`` (not ``sleep``) so stop() interrupts a wait
     immediately — worker shutdown must not dangle for up to a full
     metrics interval.
+
+    Tick failures are **counted, never swallowed silently**: a
+    persistently-failing tick stops refreshing the heartbeat file, which
+    to the fleet is indistinguishable from a dead host — its leases get
+    stolen mid-work (parallel/queue.py's steal predicate is exactly this
+    staleness). The accounting (:attr:`tick_errors_total`,
+    :attr:`consecutive_errors`, :attr:`last_tick_error`) is exported as
+    ``vft_heartbeat_tick_errors_total`` and surfaced inside the next
+    *successful* heartbeat (telemetry/recorder.py ``build_heartbeat``),
+    so an operator reading the file sees "this host is alive but its
+    liveness channel was failing" instead of nothing at all.
     """
 
     def __init__(self, tick: Callable[[], None], interval_s: float) -> None:
@@ -77,6 +88,10 @@ class HeartbeatThread:
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.tick_errors_total = 0
+        self.consecutive_errors = 0
+        self.last_tick_error: Optional[str] = None
+        self.frozen_ticks = 0
 
     def start(self) -> None:
         if self._thread is not None:
@@ -86,13 +101,38 @@ class HeartbeatThread:
         self._thread.start()
 
     def _run(self) -> None:
+        from ..utils import inject
         while not self._stop.wait(self.interval_s):
             try:
+                # chaos hook (utils/inject.py `heartbeat.tick`): `freeze`
+                # silently skips ticks — the host looks dead while its
+                # work continues (the lease-steal-of-a-live-host case);
+                # raise-kind faults exercise the error accounting below
+                fault = inject.fire("heartbeat.tick")
+                if fault is not None and fault.kind == "freeze":
+                    self.frozen_ticks += 1
+                    continue
                 self._tick()
-            except Exception:
+                self.consecutive_errors = 0
+            except Exception as e:
                 # liveness reporting must never kill (or be killed by)
-                # the extraction it observes; the next tick retries
-                pass
+                # the extraction it observes — but a failing tick is
+                # itself a liveness event: count it, export it, and keep
+                # the last error for the next successful heartbeat
+                self.tick_errors_total += 1
+                self.consecutive_errors += 1
+                self.last_tick_error = f"{type(e).__name__}: {e}"
+                try:
+                    from .. import telemetry
+                    telemetry.inc("vft_heartbeat_tick_errors_total")
+                except Exception:
+                    pass
+                if self.consecutive_errors == 1 or \
+                        self.consecutive_errors % 10 == 0:
+                    print(f"heartbeat: tick failed ({self.last_tick_error}); "
+                          f"{self.consecutive_errors} consecutive failure(s)"
+                          " — this host will look STALLED to the fleet if "
+                          "they persist")
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()

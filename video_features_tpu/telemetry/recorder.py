@@ -266,6 +266,12 @@ class TelemetryRecorder:
             "videos_done": done,
             "videos_per_s": vps,
             "last_video": last_video,
+            # heartbeat self-health (telemetry/heartbeat.py): a host whose
+            # ticks were failing looks dead to the fleet; the next
+            # successful write carries the evidence, so "alive but the
+            # liveness channel broke" is distinguishable from "dead"
+            "tick_errors": int(self._hb.tick_errors_total),
+            "last_tick_error": self._hb.last_tick_error,
             "stage_delta": delta,
             # fan-out backpressure (parallel/fanout.py): per-family queue
             # depth gauges + cumulative blocked/starved totals, so a

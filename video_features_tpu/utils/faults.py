@@ -37,6 +37,7 @@ The **decode degradation ladder** also lives here (:data:`LADDER`,
 """
 from __future__ import annotations
 
+import errno
 import json
 import os
 import random
@@ -77,6 +78,21 @@ class FatalError(Exception):
 _POISON_MARKERS = ("ValueError", "PoisonError", "NonFiniteFeatureError",
                    "No decodable frames", "Cannot determine fps")
 
+#: OSError errnos that mean the ENVIRONMENT cannot take writes at all —
+#: full disk, exceeded quota, read-only remount. Retrying burns the whole
+#: retry budget plus backoff wall-clock per video and every video fails
+#: the same way, turning one full disk into a slow fleet-wide hang; fail
+#: the video immediately so the operator sees N fast FATALs, not a crawl
+_FATAL_ERRNOS = frozenset({
+    getattr(errno, name) for name in ("ENOSPC", "EDQUOT", "EROFS")
+    if hasattr(errno, name)
+})
+
+#: the same verdict for worker-FORWARDED errors: the decode subprocess
+#: protocol ships strings, and str(OSError) keeps the strerror
+_FATAL_MARKERS = ("ENOSPC", "EDQUOT", "EROFS", "No space left on device",
+                  "Disk quota exceeded", "Read-only file system")
+
 
 def classify(exc: BaseException) -> str:
     """Map an exception to TRANSIENT / POISON / FATAL.
@@ -114,9 +130,17 @@ def classify(exc: BaseException) -> str:
             return TRANSIENT  # OOM-SIGKILLed decode worker (utils/io.py)
         if any(m in msg for m in _POISON_MARKERS):
             return POISON  # worker-forwarded child exception, by name
+        if any(m in msg for m in _FATAL_MARKERS):
+            return FATAL  # forwarded full-disk/quota/read-only verdicts
         return TRANSIENT  # spawn failures, queue breakage, ffmpeg blips
-    if isinstance(exc, (OSError, MemoryError)):
-        return TRANSIENT  # NFS hiccup / host memory pressure / URLError
+    if isinstance(exc, OSError):
+        if exc.errno in _FATAL_ERRNOS:
+            # full disk / quota / read-only: retrying cannot help and every
+            # other video fails identically — fail fast, keep isolation
+            return FATAL
+        return TRANSIENT  # NFS hiccup / EIO blip / URLError
+    if isinstance(exc, MemoryError):
+        return TRANSIENT  # host memory pressure may clear
     return TRANSIENT
 
 

@@ -1,0 +1,343 @@
+"""Deterministic fault injection: prove the durability contracts.
+
+PRs 1-8 accumulated a stack of crash-safety contracts — atomic-rename
+sinks, verify-before-trust cache entries, exactly-once fleet done
+markers, lease-steal reclamation, POISON quarantine, torn-tail-healing
+journals — but each was exercised only by a bespoke hand-built failure
+in one test. This module makes faults a *first-class, seeded, replayable
+input*: a run armed with an injection plan fires the same faults at the
+same sites in the same order every time, so a chaos matrix can sweep
+seeds and any failing seed replays exactly from its recorded plan
+(tests/test_chaos.py; docs/chaos.md).
+
+**Sites** (:data:`SITES`) are named chokepoints threaded through the
+durability surface — decode reads, the three legs of the atomic sink
+write, cache store/lookup, queue claim/steal, the serve spool claim, the
+heartbeat tick, and a kill-self site in the per-video attempt loop. A
+site costs ONE module-global read when injection is off (the
+telemetry/trace.py discipline): ``fire(site)`` returns ``None``
+immediately, and per-frame call sites additionally hold the active plan
+in a local so even the call can be skipped.
+
+**Plans** are compact strings, validated by ``sanity_check`` at launch::
+
+    inject="seed=7;sink.fsync=enospc@n1;decode.read=eio@p0.05"
+
+``seed=<int>`` seeds every probabilistic trigger (per-site independent
+streams, so adding a rule never perturbs another site's draws). Each
+rule is ``<site>=<fault>@<trigger>``:
+
+  ==========  ==============================================================
+  fault       behavior when the trigger matches
+  ==========  ==============================================================
+  ``eio``     raise ``OSError(EIO)`` — a transient disk/NFS error
+  ``enospc``  raise ``OSError(ENOSPC)`` — disk full (FATAL taxonomy)
+  ``edquot``  raise ``OSError(EDQUOT)`` — quota exceeded (FATAL)
+  ``erofs``   raise ``OSError(EROFS)`` — read-only filesystem (FATAL)
+  ``error``   raise ``RuntimeError`` — a generic software fault
+  ``torn``    ``sink.tmp_write``: write a truncated prefix, then raise
+              EIO; ``cache.lookup``: truncate the stored entry so
+              verify-before-trust must catch it
+  ``drop``    rename/steal sites: the operation is lost (site-specific)
+  ``skew``    ``queue.claim``: stamp an already-expired lease deadline
+  ``freeze``  ``heartbeat.tick``: silently skip the tick (host looks dead)
+  ``kill``    ``os.kill(getpid(), SIGKILL)`` — no drain, no final heartbeat
+  ==========  ==============================================================
+
+Triggers: ``n<int>`` (exactly the Nth hit of that site, 1-based),
+``first`` (= ``n1``), ``every<int>`` (every Nth hit), ``after<int>``
+(every hit past the Nth), ``p<float>`` (each hit independently with
+probability p, drawn from the seeded per-site stream).
+
+**Arming**: cli.py / serve.py arm the plan from the ``inject=`` config
+key at run start and disarm in their ``finally``; the ``VFT_INJECT``
+environment variable *overrides* the config key and also arms
+subprocess workers (decode worker processes, fleet-queue workers) at
+import time — they never run the CLI prologue.
+
+Every fired fault bumps ``vft_inject_fired_total{site=...}`` (when
+telemetry is live) and the plan's own tally, so a chaos run records
+exactly what it injected; ``scripts/audit_run.py`` (vft-audit) then
+verifies the cross-subsystem invariants the fault was supposed to be
+unable to break.
+"""
+from __future__ import annotations
+
+import errno
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+#: every named injection site, and the module that hosts its hook
+SITES = (
+    "decode.read",          # utils/io.py _FrameStream.read (all decode paths
+                            # incl. the shared FrameBus, parallel/fanout.py)
+    "sink.tmp_write",       # utils/sinks.py _write_bytes_atomic, pre-write
+    "sink.fsync",           # utils/sinks.py _write_bytes_atomic, pre-fsync
+    "sink.rename",          # utils/sinks.py _write_bytes_atomic, pre-replace
+    "cache.store",          # cache.py FeatureCache.store
+    "cache.lookup",         # cache.py FeatureCache.lookup
+    "queue.claim",          # parallel/queue.py WorkQueue.claim_next
+    "queue.steal_staging",  # parallel/queue.py WorkQueue._requeue, between
+                            # the staging rename and the pending re-publish
+    "spool.claim",          # serve.py ServeLoop._claim_next
+    "heartbeat.tick",       # telemetry/heartbeat.py HeartbeatThread._run
+    "worker.kill",          # utils/sinks.py safe_extract, per attempt
+)
+
+#: raise-kind faults -> the errno they raise with (None = RuntimeError)
+_RAISE_ERRNO = {
+    "eio": errno.EIO,
+    "enospc": errno.ENOSPC,
+    "edquot": errno.EDQUOT,
+    "erofs": errno.EROFS,
+    "error": None,
+}
+
+#: behavioral faults: ``fire`` returns them for the call site to apply
+_BEHAVIORAL = ("torn", "drop", "skew", "freeze")
+
+FAULT_KINDS = tuple(_RAISE_ERRNO) + _BEHAVIORAL + ("kill",)
+
+#: which behavioral kinds make sense where — parse-time validation, so a
+#: plan that asks for ``skew`` at a sink fails at launch, not mid-run
+_BEHAVIORAL_SITES = {
+    "torn": ("sink.tmp_write", "cache.lookup"),
+    "drop": ("sink.rename", "queue.steal_staging"),
+    "skew": ("queue.claim",),
+    "freeze": ("heartbeat.tick",),
+}
+
+
+class Fault:
+    """One armed fault returned to (behavioral) call sites."""
+
+    __slots__ = ("site", "kind", "hit")
+
+    def __init__(self, site: str, kind: str, hit: int) -> None:
+        self.site = site
+        self.kind = kind
+        self.hit = hit
+
+    def __repr__(self) -> str:
+        return f"Fault({self.site}={self.kind}@hit{self.hit})"
+
+
+class _Rule:
+    __slots__ = ("site", "kind", "trigger", "value", "rng")
+
+    def __init__(self, site: str, kind: str, trigger: str, value: float,
+                 seed: int) -> None:
+        self.site = site
+        self.kind = kind
+        self.trigger = trigger
+        self.value = value
+        # per-site independent stream: adding/removing another site's
+        # rule can never shift this one's draws between runs
+        self.rng = random.Random(f"{seed}:{site}:{kind}")
+
+    def should_fire(self, hit: int) -> bool:
+        if self.trigger == "n":
+            return hit == int(self.value)
+        if self.trigger == "every":
+            return hit % int(self.value) == 0
+        if self.trigger == "after":
+            return hit > int(self.value)
+        # "p": one deterministic draw per hit, fire or not
+        return self.rng.random() < self.value
+
+
+class InjectionPlan:
+    """A parsed, armed plan: per-site hit counters + fire decisions.
+
+    Thread-safe: sites are hit from decode threads, the heartbeat
+    flusher and fleet workers concurrently; the lock only exists while a
+    plan is armed (chaos runs), never on the injection-off path.
+    """
+
+    def __init__(self, spec: str, seed: int,
+                 rules: Dict[str, _Rule]) -> None:
+        self.spec = spec
+        self.seed = seed
+        self.rules = rules
+        self.hits: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def check(self, site: str, ctx: Dict[str, Any]) -> Optional[Fault]:
+        rule = self.rules.get(site)
+        if rule is None:
+            return None
+        with self._lock:
+            hit = self.hits.get(site, 0) + 1
+            self.hits[site] = hit
+            if not rule.should_fire(hit):
+                return None
+            self.fired[site] = self.fired.get(site, 0) + 1
+        return self._apply(rule, site, hit, ctx)
+
+    def _apply(self, rule: _Rule, site: str, hit: int,
+               ctx: Dict[str, Any]) -> Optional[Fault]:
+        detail = " ".join(f"{k}={v}" for k, v in ctx.items() if v is not None)
+        print(f"INJECT: {site}={rule.kind} fired (hit {hit}, seed "
+              f"{self.seed}{', ' + detail if detail else ''})")
+        from .. import telemetry
+        telemetry.inc("vft_inject_fired_total", site=site)
+        if rule.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(30)  # SIGKILL is not synchronous; never fall through
+        if rule.kind in _RAISE_ERRNO:
+            eno = _RAISE_ERRNO[rule.kind]
+            if eno is None:
+                raise RuntimeError(
+                    f"injected fault at {site} (hit {hit}, seed {self.seed})")
+            raise OSError(eno, f"injected {rule.kind.upper()} at {site} "
+                               f"(hit {hit}, seed {self.seed})")
+        return Fault(site, rule.kind, hit)
+
+    def summary(self) -> str:
+        with self._lock:
+            fired = dict(self.fired)
+            hits = dict(self.hits)
+        parts = [f"{s}:{fired.get(s, 0)}/{hits[s]}" for s in sorted(hits)]
+        return (f"inject: seed={self.seed} fired/hits "
+                f"{{{', '.join(parts) or 'no sites hit'}}} "
+                f"(plan {self.spec!r})")
+
+
+def parse_plan(spec: str) -> InjectionPlan:
+    """Parse (and validate) an ``inject=`` plan string; raises
+    ``ValueError`` with the offending clause on any malformed piece, so
+    ``sanity_check`` fails a typo'd plan at launch."""
+    if not isinstance(spec, str) or not spec.strip():
+        raise ValueError(f"inject={spec!r}: expected a non-empty plan "
+                         "string like 'seed=1;sink.fsync=enospc@n1'")
+    seed = 0
+    rules: Dict[str, _Rule] = {}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(f"inject: clause {clause!r} is not key=value")
+        key, val = (p.strip() for p in clause.split("=", 1))
+        if key == "seed":
+            try:
+                seed = int(val)
+            except ValueError:
+                raise ValueError(f"inject: seed={val!r} is not an int")
+            continue
+        if key not in SITES:
+            raise ValueError(f"inject: unknown site {key!r} "
+                             f"(sites: {', '.join(SITES)})")
+        kind, trigger, value = _parse_fault(key, val)
+        rules[key] = _Rule(key, kind, trigger, value, seed)
+    # rules built before the seed clause would carry the default seed:
+    # rebuild so clause order never matters
+    rules = {s: _Rule(s, r.kind, r.trigger, r.value, seed)
+             for s, r in rules.items()}
+    if not rules:
+        raise ValueError(f"inject={spec!r}: plan has no site rules")
+    return InjectionPlan(spec, seed, rules)
+
+
+def _parse_fault(site: str, val: str) -> Tuple[str, str, float]:
+    kind, sep, trig = val.partition("@")
+    kind = kind.strip()
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"inject: {site}: unknown fault {kind!r} "
+                         f"(faults: {', '.join(FAULT_KINDS)})")
+    if kind in _BEHAVIORAL and site not in _BEHAVIORAL_SITES[kind]:
+        raise ValueError(
+            f"inject: fault {kind!r} only applies at "
+            f"{'/'.join(_BEHAVIORAL_SITES[kind])}, not {site!r}")
+    trig = (trig.strip() or "first") if sep else "first"
+    if trig == "first":
+        return kind, "n", 1.0
+    for prefix in ("every", "after"):  # before 'n'/'p': longest first
+        if trig.startswith(prefix):
+            try:
+                n = int(trig[len(prefix):])
+            except ValueError:
+                n = 0
+            if n < 1:
+                raise ValueError(f"inject: {site}: trigger {trig!r} needs "
+                                 f"a positive int after '{prefix}'")
+            return kind, prefix, float(n)
+    if trig.startswith("n"):
+        try:
+            n = int(trig[1:])
+        except ValueError:
+            n = 0
+        if n < 1:
+            raise ValueError(f"inject: {site}: trigger {trig!r} needs a "
+                             "positive int after 'n'")
+        return kind, "n", float(n)
+    if trig.startswith("p"):
+        try:
+            p = float(trig[1:])
+        except ValueError:
+            p = -1.0
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"inject: {site}: trigger {trig!r} needs a "
+                             "probability in (0, 1] after 'p'")
+        return kind, "p", p
+    raise ValueError(f"inject: {site}: unknown trigger {trig!r} "
+                     "(use n<int>, first, every<int>, after<int>, p<float>)")
+
+
+# -- the armed plan (one module global; None = injection off) ----------------
+
+_active: Optional[InjectionPlan] = None
+
+
+def _set_active(plan: Optional[InjectionPlan]) -> None:
+    global _active
+    _active = plan
+
+
+def active() -> Optional[InjectionPlan]:
+    """The armed plan, if any (one global read — hot call sites hold the
+    result in a local and skip the per-hit work entirely when None)."""
+    return _active
+
+
+def fire(site: str, **ctx: Any) -> Optional[Fault]:
+    """The injection hook. Off (no plan): one global read, return None.
+    Armed: count the hit; when the site's trigger matches, raise-kind
+    faults raise here, ``kill`` SIGKILLs the process, and behavioral
+    faults (torn/drop/skew/freeze) are returned for the call site to
+    apply. Returns None when nothing fires."""
+    plan = _active
+    if plan is None:
+        return None
+    return plan.check(site, ctx)
+
+
+def arm_for_run(config_spec: Optional[str]) -> Optional[InjectionPlan]:
+    """Arm the plan for one CLI/serve run: ``VFT_INJECT`` (the
+    subprocess-worker override) wins over the ``inject=`` config key.
+    Returns the armed plan (or None — which also DISARMS any plan a
+    previous in-process run left behind)."""
+    spec = os.environ.get("VFT_INJECT") or config_spec
+    plan = parse_plan(spec) if spec else None
+    _set_active(plan)
+    return plan
+
+
+def disarm() -> None:
+    """Back to the import-time baseline: the ``VFT_INJECT`` env plan if
+    set (spawned workers must stay armed for their whole life), else
+    off."""
+    spec = os.environ.get("VFT_INJECT")
+    _set_active(parse_plan(spec) if spec else None)
+
+
+# subprocess workers (decode worker processes, fleet-queue/serve workers
+# launched with VFT_INJECT in their environment) arm at import time —
+# they never run the CLI prologue that calls arm_for_run
+if os.environ.get("VFT_INJECT"):
+    _active = parse_plan(os.environ["VFT_INJECT"])

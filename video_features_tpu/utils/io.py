@@ -269,8 +269,17 @@ class _FrameStream:
         self.cap = cv2.VideoCapture(path)
         self._first = True
         self._order = channel_order
+        self._path = str(path)
+        # chaos hook (utils/inject.py `decode.read`): the armed plan is
+        # captured once per stream so the per-frame cost when injection
+        # is off stays one attribute read — every decode path (serial,
+        # segment workers, the shared FrameBus) reads through here
+        from . import inject
+        self._inject = inject.active()
 
     def read(self) -> Optional[np.ndarray]:
+        if self._inject is not None:
+            self._inject.check("decode.read", {"video": self._path})
         # local ref: a concurrent release() (deadline watchdog) nulls
         # self.cap; going through the local keeps this thread's call
         # coherent and the next loop iteration observes the None

@@ -17,6 +17,7 @@ mechanism.
 """
 from __future__ import annotations
 
+import errno
 import hashlib
 import io
 import os
@@ -27,6 +28,8 @@ from pathlib import Path
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+from . import inject
 
 EXTS = {"save_numpy": ".npy", "save_pickle": ".pkl"}
 
@@ -44,7 +47,14 @@ def load_numpy(fpath):
 def _write_bytes_atomic(fpath, data: bytes) -> None:
     """Temp file in the target dir + flush + fsync + ``os.replace`` — the
     same contract as native write_npy_atomic, for already-serialized
-    bytes (the hash-before-rename artifact-digest path)."""
+    bytes (the hash-before-rename artifact-digest path).
+
+    The unlink-on-failure is load-bearing, not defensive: a raise
+    anywhere between mkstemp and ``os.replace`` (ENOSPC at fsync, a
+    failed rename) must not leak the ``.tmp`` file into the output dir
+    forever — ``vft-audit``'s no-tmp-litter invariant and the injected
+    ``sink.*`` faults (utils/inject.py, tests/test_inject.py) pin it.
+    """
     d = os.path.dirname(fpath) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d,
@@ -52,9 +62,22 @@ def _write_bytes_atomic(fpath, data: bytes) -> None:
                                suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
+            fault = inject.fire("sink.tmp_write", path=str(fpath))
+            if fault is not None and fault.kind == "torn":
+                # a short write: the disk filled (or the process died)
+                # mid-write — exactly what atomic rename must hide
+                f.write(data[:max(1, len(data) // 2)])
+                f.flush()
+                raise OSError(errno.EIO,
+                              f"injected torn write for {fpath}")
             f.write(data)
             f.flush()
+            inject.fire("sink.fsync", path=str(fpath))
             os.fsync(f.fileno())
+        fault = inject.fire("sink.rename", path=str(fpath))
+        if fault is not None and fault.kind == "drop":
+            raise OSError(errno.EIO,
+                          f"injected rename drop for {fpath}")
         os.replace(tmp, fpath)
     except BaseException:
         try:
@@ -71,12 +94,18 @@ def write_numpy(fpath, value, want_digest: bool = False
     hashed before the rename — so the digest can never describe a file a
     concurrent worker replaced underneath us)."""
     from .. import native
-    if want_digest:
+    if want_digest or inject.active() is not None:
+        # the Python path is byte-identical to the native writer (pinned
+        # by tests/test_sinks.py); chaos runs take it unconditionally so
+        # the sink.{tmp_write,fsync,rename} injection sites cover every
+        # .npy write, not just the digest-requesting ones
         buf = io.BytesIO()
         np.save(buf, np.asarray(value))
         data = buf.getvalue()
         _write_bytes_atomic(fpath, data)
-        return len(data), hashlib.sha256(data).hexdigest()
+        if want_digest:
+            return len(data), hashlib.sha256(data).hexdigest()
+        return None
     # temp-file + fsync + atomic rename (native/vft_native.cpp): a preempted
     # worker can never leave a half-written feature file behind
     if native.write_npy_atomic(fpath, value):
@@ -251,6 +280,10 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
         ctx = faults.FaultContext(video_path,
                                   deadline_s=policy.deadline_s,
                                   decode_override=override)
+        # chaos hook (utils/inject.py): `worker.kill=kill@nK` SIGKILLs
+        # this worker at the K-th video attempt fleet-wide — the
+        # deterministic replay of test_chaos's scripted preemptions
+        inject.fire("worker.kill", video=str(video_path), attempt=attempt)
         try:
             # one timeline span per attempt (trace=true; no-op otherwise):
             # the unit trace_report.py cuts the per-video critical path on,

@@ -574,6 +574,62 @@ def bench_inject_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_slo_overhead(families=("resnet", "clip", "s3d"),
+                       n_copies: int = 2) -> dict:
+    """Wall-clock cost of the fleet ops plane (ISSUE 10: request-id
+    correlation + serve SLO accounting) on the same smoke corpus as
+    bench_trace_overhead. ``off`` is the stock path — every correlated
+    emitter added exactly one thread-local read there, which must stay
+    free; ``on`` runs telemetry+health under an armed request context
+    (telemetry/context.py use_request), i.e. the serve-grade stamping
+    path: request ids into span/health records plus the histogram
+    observes the SLO split rides on. Budget <= 1.05x, tracked per round
+    like the trace/health/inject ratios."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the SLO bench")
+    from video_features_tpu.cli import main as cli_main
+    from video_features_tpu.telemetry import use_request
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_slo_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_slo{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra, request_id=None) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                if request_id is None:
+                    cli_main(argv)
+                else:
+                    with use_request(request_id):
+                        cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", [])
+        on = run("on", ["telemetry=true", "health=true",
+                        "metrics_interval_s=60"],
+                 request_id="bench-request")
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_cache(family: str = "resnet", n_copies: int = 3) -> dict:
     """Repeat-content avoidance ratio (ISSUE 7): the SAME corpus run
     twice with ``cache=true`` into a fresh content-addressed store
@@ -1428,6 +1484,29 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: inject-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # fleet ops plane (ISSUE 10): request-id correlation reads on every
+    # emitter + the serve SLO histogram path, vs the stock run — the
+    # fourth always-on knob held to the same <= 1.05x budget
+    try:
+        so = bench_slo_overhead()
+        metrics.append({
+            "metric": "serve SLO + request-id instrumentation overhead "
+                      f"(correlated vs off, {'+'.join(so['families'])})",
+            "value": so["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": so["off_s"],
+            "on_s": so["on_s"],
+            "note": f"{so['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs; on = telemetry+health under an armed "
+                    "request context (telemetry/context.py), the "
+                    "serve-grade stamping path — off is one thread-local "
+                    "read per emitter (docs/observability.md 'One view "
+                    "of the fleet')",
+        })
+    except Exception as e:
+        print(f"WARNING: SLO-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # repeat-content avoidance (cache.py): second pass over the same
     # corpus must be near-pure cache-hit throughput; tracked per round

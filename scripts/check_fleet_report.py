@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Fleet-report quick-gate: a REAL 2-worker ``fleet=queue`` run must
+render as ONE fleet view, with exactly-once done counts and a stitched,
+wall-clock-aligned, schema-clean fleet trace (ISSUE 10).
+
+Sibling of ``check_fleet_smoke.py`` (which pins the queue's drain
+semantics); this gate pins the *ops plane* over the same kind of run:
+
+  1. **both hosts in one report**: two real ``fleet=queue`` CLI worker
+     processes (telemetry+trace on) drain a 4-video queue into a shared
+     out dir; ``vft-fleet`` must show BOTH workers' heartbeats
+     (finished), their fleet tallies, and per-family throughput;
+  2. **exactly-once done counts**: the report's queue section reads
+     pending=0, claimed=0, done=4 off the ``_queue`` dir, and the two
+     workers' claim tallies sum to exactly 4;
+  3. **stitched trace**: ``--stitch`` merges the per-host
+     ``_trace_{host_id}.json`` files into one Perfetto doc with one
+     process lane per worker, ``aligned`` on the wall-clock anchors,
+     every complete event still carrying the per-ph required fields
+     ``check_trace_schema.py`` pins (the stitcher must never strip
+     them);
+  4. the ``--prom`` fleet textfile parses line-for-line.
+
+Exit 0 = contract holds; exit 1 = every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml); the synthetic-artifact twin
+is tests/test_fleet_report.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+N_VIDEOS = 4
+TIMEOUT_S = 540
+
+BASE = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+        "allow_random_weights=true", "on_extraction=save_numpy",
+        "extraction_total=4", "batch_size=8", "video_workers=1",
+        "retry_attempts=1", "fleet=queue", "telemetry=true", "trace=true",
+        "metrics_interval_s=1", "fleet_lease_s=30"]
+
+_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from video_features_tpu.cli import main
+    main({argv!r})
+""")
+
+
+def check(td: Path) -> List[str]:
+    from video_features_tpu import fleet_report
+    from video_features_tpu.telemetry.trace import REQUIRED_X_FIELDS
+    errs: List[str] = []
+    vids = []
+    for i in range(N_VIDEOS):
+        dst = td / f"fleet{i}.mp4"
+        shutil.copy(SAMPLE, dst)
+        vids.append(str(dst))
+    out = td / "out"
+    argv = BASE + [f"output_path={out}", f"tmp_path={td / 'tmp'}",
+                   "video_paths=[" + ",".join(vids) + "]"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _WORKER.format(repo=str(REPO_ROOT), argv=argv)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+        for _ in range(2)]
+    for p in procs:
+        try:
+            rc = p.wait(timeout=TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            return [f"fleet=queue worker timed out after {TIMEOUT_S}s"]
+        if rc != 0:
+            errs.append(f"fleet=queue worker exited rc={rc}")
+    run_dir = out / "resnet" / "resnet18"
+
+    # 1+2: one view, both hosts, exactly-once counts
+    agg = fleet_report.aggregate(str(run_dir))
+    hosts = [e for e in agg["hosts"]
+             if e.get("hb") is not None and not e["prior_run"]]
+    if len(hosts) != 2:
+        errs.append(f"report shows {len(hosts)} host(s), wanted both "
+                    "workers")
+    if agg["n_hosts"]["finished"] != 2:
+        errs.append(f"hosts not all FINISHED: {agg['n_hosts']}")
+    q = agg["queue"] or {}
+    if (q.get("pending"), q.get("claimed"), q.get("done")) != \
+            (0, 0, N_VIDEOS):
+        errs.append(f"queue counts {q} != pending=0/claimed=0/"
+                    f"done={N_VIDEOS} (exactly-once drain)")
+    claimed_total = sum(
+        int((e["hb"].get("fleet") or {}).get("claimed", 0))
+        for e in hosts)
+    done_total = sum(
+        int((e["hb"].get("fleet") or {}).get("done", 0))
+        for e in hosts)
+    if done_total != N_VIDEOS:
+        errs.append(f"workers' done tallies sum to {done_total}, "
+                    f"wanted {N_VIDEOS}")
+    if claimed_total < N_VIDEOS:
+        errs.append(f"workers' claim tallies sum to {claimed_total} < "
+                    f"{N_VIDEOS}")
+    fam = agg["families"].get("resnet") or {}
+    if fam.get("done") != N_VIDEOS:
+        errs.append(f"per-family throughput shows {fam} — wanted "
+                    f"done={N_VIDEOS}")
+    text = "\n".join(fleet_report.render(agg))
+    for e in hosts:
+        hid = str(e["hb"].get("host_id"))
+        if hid not in text:
+            errs.append(f"host {hid} missing from the rendered report")
+
+    # 3: stitched trace — one lane per worker, aligned, fields intact
+    traces = fleet_report.find_trace_files(str(run_dir))
+    if len(traces) != 2:
+        errs.append(f"expected 2 per-host traces, found "
+                    f"{[p.name for p in traces]}")
+    path, merged = fleet_report.stitch(str(run_dir))
+    other = merged.get("otherData", {})
+    if path is None or not os.path.exists(path):
+        errs.append("--stitch wrote no fleet trace")
+    if len(other.get("hosts", [])) != 2:
+        errs.append(f"stitched lanes {other.get('hosts')} != 2 hosts")
+    if not other.get("aligned"):
+        errs.append("stitched trace not wall-clock aligned "
+                    f"(unanchored={other.get('unanchored')})")
+    lanes = {h["host_id"] for h in other.get("hosts", [])}
+    hb_ids = {str(e["hb"].get("host_id")) for e in hosts}
+    if lanes != hb_ids:
+        errs.append(f"stitch lanes {lanes} != heartbeat host_ids "
+                    f"{hb_ids}")
+    xs = [ev for ev in merged.get("traceEvents", [])
+          if ev.get("ph") == "X"]
+    if not xs:
+        errs.append("stitched trace holds no complete events")
+    for ev in xs:
+        missing = [f for f in REQUIRED_X_FIELDS if f not in ev]
+        if missing:
+            errs.append(f"stitched event {ev.get('name')!r} lost "
+                        f"required fields {missing}")
+            break
+    pids = {ev.get("pid") for ev in xs}
+    if len(pids) != 2:
+        errs.append(f"stitched events use {len(pids)} pid lane(s), "
+                    "wanted one per host")
+
+    # 4: the fleet prom textfile parses
+    prom = td / "fleet.prom"
+    rc = fleet_report.main([str(run_dir), "--prom", str(prom)])
+    if rc != 0 or not prom.exists():
+        errs.append(f"--prom failed (rc={rc})")
+    else:
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+$')
+        for line in prom.read_text().splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            if not line_re.match(line):
+                errs.append(f"unparseable prom line: {line!r}")
+                break
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"fleet-report gate SKIP: vendored sample missing at "
+              f"{SAMPLE}")
+        return 0
+    import contextlib
+    with tempfile.TemporaryDirectory(prefix="vft_fleet_report_gate_") \
+            as td:
+        with contextlib.redirect_stdout(sys.stderr):
+            errs = check(Path(td))
+    if errs:
+        print("fleet-report gate FAILED:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print("fleet-report gate OK: 2 real queue workers rendered as one "
+          f"fleet view (done={N_VIDEOS} exactly once), stitched trace "
+          "aligned with one lane per host, prom textfile parses")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""vft-fleet, checkout form: one view of the whole fleet.
+
+Merges every host's heartbeats, fleet-queue counts, cache hit rates,
+per-family throughput and serve SLO attainment under a shared
+out_root/spool into one report (``--watch`` live refresh, ``--prom``
+fleet textfile), stitches all hosts' ``_trace.json`` timelines onto one
+wall-clock-aligned Perfetto file (``--stitch``), and retrieves every
+artifact a request id produced (``--request``).
+
+Thin wrapper over ``video_features_tpu.fleet_report`` (also installed
+as the ``vft-fleet`` console script) so an operator on a bare checkout
+can run ``python scripts/fleet_report.py /shared/out`` like the other
+scripts/ tools. See docs/observability.md "One view of the fleet".
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.fleet_report import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,28 +101,40 @@ def render_manifest(man: dict) -> List[str]:
     return lines
 
 
-def _fleet_stragglers(hbs: List[dict], now: float) -> set:
-    """host_ids binding the fleet: a host still holding active claims
-    while the shared queue's pending is empty AND at least one other
-    live fleet host sits idle — everyone else is waiting on it (the
-    per-host idle tail ``fleet.idle_wait`` makes visible in traces)."""
-    live = []
-    for hb in hbs:
-        fl = hb.get("fleet")
-        if not isinstance(fl, dict) or hb.get("final"):
-            continue
-        interval = float(hb.get("interval_s", 30.0) or 30.0)
-        if now - float(hb.get("time", 0)) > STALL_INTERVALS * interval:
-            continue
-        live.append((str(hb.get("host_id")), fl))
-    if len(live) < 2:
-        return set()
-    idle = [h for h, fl in live if not fl.get("active_claims")]
-    if not idle:
-        return set()
-    return {h for h, fl in live
-            if fl.get("active_claims")
-            and not (fl.get("queue") or {}).get("pending", 0)}
+# straggler detection is shared with the fleet-wide aggregator
+# (video_features_tpu/fleet_report.py, `vft-fleet`) — one definition,
+# two altitudes of report
+from video_features_tpu.fleet_report import (  # noqa: E402
+    fleet_stragglers as _fleet_stragglers)
+
+
+def _render_serve(hb: dict) -> List[str]:
+    """The per-host ``serve:`` line(s): state/queue plus the SLO block
+    (attainment %, p50/p95/p99 of the queue-wait and service splits,
+    violation count) the serve heartbeat section publishes."""
+    serve = hb.get("serve")
+    if not isinstance(serve, dict):
+        return []
+    line = (f"    serve: {serve.get('state')}  "
+            f"pending={serve.get('pending', 0)} "
+            f"inflight={serve.get('inflight', 0)}  requests: "
+            + ", ".join(f"{k}={v}" for k, v in
+                        sorted((serve.get("requests") or {}).items())))
+    lines = [line]
+    slo = serve.get("slo")
+    if isinstance(slo, dict) and slo.get("requests"):
+        svc = slo.get("service") or {}
+        qw = slo.get("queue_wait") or {}
+        sl = (f"    slo: service p50/p95/p99="
+              f"{svc.get('p50')}/{svc.get('p95')}/{svc.get('p99')}s  "
+              f"wait p50/p95/p99="
+              f"{qw.get('p50')}/{qw.get('p95')}/{qw.get('p99')}s")
+        if slo.get("slo_s") is not None:
+            sl += (f"  objective={slo['slo_s']}s "
+                   f"violations={slo.get('violations', 0)} "
+                   f"attainment={slo.get('attainment_pct')}%")
+        lines.append(sl)
+    return lines
 
 
 def render_heartbeats(paths: List[str], now: float,
@@ -199,7 +211,26 @@ def render_heartbeats(paths: List[str], now: float,
             if str(hb.get("host_id")) in stragglers:
                 line += "  STRAGGLER (fleet idle behind this host)"
             lines.append(line)
+        lines += _render_serve(hb)
     return lines
+
+
+def slo_violation_tallies(paths: List[str], run_id: Optional[str] = None,
+                          started_time: Optional[float] = None
+                          ) -> Dict[str, int]:
+    """``{host_id: violations}`` over the current run's serve heartbeats
+    — the ``--fail-on-slo`` gate's input (prior-run files excluded, like
+    the rendering)."""
+    out: Dict[str, int] = {}
+    for p in paths:
+        hb = _load_json(p)
+        if hb is None or not matches_run(hb, run_id, started_time):
+            continue
+        slo = (hb.get("serve") or {}).get("slo") \
+            if isinstance(hb.get("serve"), dict) else None
+        if isinstance(slo, dict) and int(slo.get("violations") or 0):
+            out[str(hb.get("host_id"))] = int(slo["violations"])
+    return out
 
 
 def render_spans(spans: List[dict], slowest: int) -> List[str]:
@@ -267,6 +298,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "failure — lets shell pipelines gate on run "
                          "health (vft ... && telemetry_report.py OUT "
                          "--fail-on-failures && deploy)")
+    ap.add_argument("--fail-on-slo", action="store_true",
+                    help="exit 1 when any current-run serve heartbeat "
+                         "reports SLO violations (serve_slo_s=, "
+                         "serve.py) — the CI/canary gate on serving "
+                         "latency")
     args = ap.parse_args(argv)
     out = args.output_dir
     if not os.path.isdir(out):
@@ -281,8 +317,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         lines += ["== run manifest (_run.json) ==",
                   "  absent (run still in flight, or telemetry=false)"]
+    hb_paths = glob.glob(os.path.join(out, HEARTBEAT_GLOB))
     lines += render_heartbeats(
-        glob.glob(os.path.join(out, HEARTBEAT_GLOB)), now,
+        hb_paths, now,
         run_id=(man or {}).get("run_id"),
         started_time=(man or {}).get("started_time"))
     spans = list(read_jsonl(os.path.join(out, SPANS_FILENAME)))
@@ -304,6 +341,16 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({', '.join(f'{k}={v}' for k, v in sorted(failure_tallies.items()))})",
               file=sys.stderr)
         return 1
+    if args.fail_on_slo:
+        slo_bad = slo_violation_tallies(
+            hb_paths, run_id=(man or {}).get("run_id"),
+            started_time=(man or {}).get("started_time"))
+        if slo_bad:
+            print("fail-on-slo: "
+                  + ", ".join(f"{h}: {v} violation(s)"
+                              for h, v in sorted(slo_bad.items())),
+                  file=sys.stderr)
+            return 1
     return 0
 
 

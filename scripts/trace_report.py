@@ -23,11 +23,17 @@ Usage:
         --merge /tmp/jaxtrace [--out combined.json]
 
 ``--merge`` splices the host timeline with a ``jax.profiler`` device
-capture (``profile_trace_dir=``, the same trace-event format) into ONE
-file Perfetto loads — host lanes and device op lanes side by side. Both
-timelines are shifted to start at 0; absolute clock alignment between
-the two captures is NOT attempted (start your capture with the run and
-read the overlap structurally, not by microsecond).
+capture (``profile_trace_dir=``, the same trace-event format) — or with
+another run's ``_trace.json`` — into ONE file Perfetto loads, host
+lanes and device op lanes side by side. When both inputs carry the
+wall-clock anchor vft traces stamp (``otherData.start_unix``,
+telemetry/trace.py) the timelines land on REAL shared wall time; two
+captures not started together stay honestly offset instead of being
+silently pinned to a common t=0. Without both anchors (a jax.profiler
+capture has none) both are rebased to start at 0 and the overlap is
+read structurally, not by microsecond. Whole-fleet stitching (N hosts'
+traces, lanes named by host_id) lives in ``vft-fleet --stitch``
+(scripts/fleet_report.py).
 
 Bucket heuristic for the verdict: ``forward`` spans are device time
 (under async dispatch: device *stall* time), ``h2d`` spans are the
@@ -54,7 +60,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from video_features_tpu.telemetry.trace import (  # noqa: E402
-    STALL_SPAN_NAMES, TRACE_FILENAME)
+    STALL_SPAN_NAMES, TRACE_FILENAME, TRACE_OUTPUT_NAMES)
 
 #: decode-lane thread-name prefix (parallel/fanout.py names its union
 #: decoder thread this); used to split "decode" into decode vs transform
@@ -75,7 +81,24 @@ def load_host_trace(path: str) -> Tuple[dict, str]:
     with an actionable message — never a JSON traceback — on a missing,
     truncated or non-trace file."""
     if os.path.isdir(path):
-        path = os.path.join(path, TRACE_FILENAME)
+        cand = os.path.join(path, TRACE_FILENAME)
+        if not os.path.exists(cand):
+            # fleet workers / serve siblings co-owning this dir write
+            # per-host _trace_{host_id}.json files instead: one is an
+            # unambiguous input; several need the fleet stitcher
+            import glob as _glob
+            others = sorted(
+                p for p in _glob.glob(os.path.join(path, "_trace*.json"))
+                if os.path.basename(p) not in TRACE_OUTPUT_NAMES)
+            if len(others) == 1:
+                cand = others[0]
+            elif len(others) > 1:
+                raise SystemExit(
+                    f"{path} holds {len(others)} per-host traces ("
+                    + ", ".join(os.path.basename(p) for p in others)
+                    + ") — pass one explicitly, or merge them all with "
+                    "`vft-fleet " + path + " --stitch`")
+        path = cand
     if not os.path.exists(path):
         raise SystemExit(f"no {TRACE_FILENAME} at {path} — was the run "
                          "launched with trace=true?")
@@ -249,27 +272,51 @@ def stage_summary(path: str) -> dict:
 
 
 def merge_traces(host: dict, device: dict) -> dict:
-    """One Perfetto-loadable file: device trace as-is (rebased to t=0),
-    host lanes rebased to t=0 under a remapped pid. No cross-clock
-    alignment — see the module docstring."""
-    dev_events = [e for e in device.get("traceEvents", [])
+    """One Perfetto-loadable file: device trace + host lanes under a
+    remapped pid.
+
+    **Clock alignment**: when BOTH inputs carry a wall-clock anchor
+    (``otherData.start_unix`` — telemetry/trace.py stamps it at recorder
+    start, and another vft host trace passed as the merge target has it
+    too), each timeline keeps its internal ``ts`` and shifts by
+    ``(anchor - min(anchors))`` — events land on REAL shared wall time,
+    so two captures not started together stay honestly offset instead of
+    being silently pinned to a common t=0. Without both anchors (the
+    usual jax.profiler capture has none) the old behavior stands: both
+    rebased to t=0, overlap read structurally."""
+
+    def _anchor(doc: dict):
+        a = (doc.get("otherData") or {}).get("start_unix")
+        return float(a) if isinstance(a, (int, float)) else None
+
+    dev_events = [dict(e) for e in device.get("traceEvents", [])
                   if isinstance(e, dict)]
     host_events = [dict(e) for e in host.get("traceEvents", [])
                    if isinstance(e, dict)]
 
-    def rebase(events: List[dict]) -> None:
+    def rebase(events: List[dict], shift: Optional[float] = None) -> None:
+        """shift=None: rebase min ts to 0; else add ``shift`` µs."""
         stamped = [e["ts"] for e in events
                    if isinstance(e.get("ts"), (int, float))]
         if not stamped:
             return
-        t0 = min(stamped)
+        delta = -min(stamped) if shift is None else shift
         for e in events:
             if isinstance(e.get("ts"), (int, float)):
-                e["ts"] = e["ts"] - t0
+                e["ts"] = e["ts"] + delta
 
-    dev_events = [dict(e) for e in dev_events]
-    rebase(dev_events)
-    rebase(host_events)
+    ha, da = _anchor(host), _anchor(device)
+    if ha is not None and da is not None:
+        t0 = min(ha, da)
+        rebase(host_events, shift=(ha - t0) * 1e6)
+        rebase(dev_events, shift=(da - t0) * 1e6)
+        how = ("wall-clock aligned on otherData.start_unix anchors "
+               f"(earliest {t0})")
+    else:
+        rebase(dev_events)
+        rebase(host_events)
+        how = ("both rebased to t=0 (no shared wall-clock anchor; vft "
+               "traces carry otherData.start_unix, this capture did not)")
     dev_pids = [e.get("pid") for e in dev_events
                 if isinstance(e.get("pid"), int)]
     host_pid = (max(dev_pids) if dev_pids else 0) + 100000
@@ -277,15 +324,23 @@ def merge_traces(host: dict, device: dict) -> dict:
         e["pid"] = host_pid
     return {"traceEvents": dev_events + host_events,
             "displayTimeUnit": "ms",
-            "otherData": {"merged": "vft host trace + jax.profiler device "
-                                    "trace, both rebased to t=0"}}
+            "otherData": {"merged": "vft host trace + device/second "
+                                    "trace: " + how,
+                          "aligned": ha is not None and da is not None}}
 
 
-def _load_device_trace(trace_dir: str) -> dict:
-    # reuse the capture-discovery logic profile_trace.py already has
-    # (newest run dir, one host, .gz handling)
+def _load_device_trace(trace_path: str) -> dict:
+    # a vft _trace.json (file, or a run dir holding one): load it as the
+    # merge target — two host traces align on their wall-clock anchors
+    cand = (os.path.join(trace_path, TRACE_FILENAME)
+            if os.path.isdir(trace_path) else trace_path)
+    if os.path.basename(cand) == TRACE_FILENAME and os.path.exists(cand):
+        doc, _ = load_host_trace(cand)
+        return doc
+    # otherwise: a jax.profiler capture dir — reuse the discovery logic
+    # profile_trace.py already has (newest run dir, one host, .gz)
     import profile_trace
-    return profile_trace.load_trace(trace_dir)
+    return profile_trace.load_trace(trace_path)
 
 
 def main() -> None:
@@ -296,7 +351,9 @@ def main() -> None:
                     help="stalls to list (default 10)")
     ap.add_argument("--merge", metavar="PROFILE_TRACE_DIR", default=None,
                     help="also merge with a jax.profiler capture "
-                         "(profile_trace_dir=) into one Perfetto file")
+                         "(profile_trace_dir=) — or another run's "
+                         "_trace.json, wall-clock aligned — into one "
+                         "Perfetto file")
     ap.add_argument("--out", default=None,
                     help="merged-trace output path (default: "
                          "_trace_merged.json next to the input)")

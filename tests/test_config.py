@@ -237,3 +237,19 @@ def test_fleet_key_validation(tmp_path):
     with pytest.raises(ValueError, match="fleet_canary"):
         sanity_check(load_config("resnet",
                                  {**base, "fleet_canary": "yes"}))
+
+
+def test_serve_slo_key_validation(tmp_path):
+    """serve_slo_s (serve.py SLO objective, ISSUE 10): null disables,
+    a positive float passes, zero/negative/garbage fail at launch —
+    never silently count zero violations against a broken objective."""
+    base = dict(video_paths="a.mp4", output_path=str(tmp_path / "o"),
+                tmp_path=str(tmp_path / "t"))
+    cfg = load_config("resnet", base)
+    assert cfg.serve_slo_s is None  # shipped default: disabled
+    sanity_check(cfg)
+    sanity_check(load_config("resnet", {**base, "serve_slo_s": 2.5}))
+    for bad in (0, -1.0, "fast"):
+        with pytest.raises(ValueError, match="serve_slo_s"):
+            sanity_check(load_config("resnet",
+                                     {**base, "serve_slo_s": bad}))

@@ -1,0 +1,253 @@
+"""The one-view fleet aggregator (fleet_report.py / `vft-fleet`,
+ISSUE 10): heartbeat merging over synthetic multi-host dirs, straggler
+flagging, wall-clock-aligned trace stitching, single-pass --watch, the
+--prom fleet textfile, and request-id retrieval.
+
+Everything here is synthetic-artifact driven — the aggregator's whole
+contract is that it reconstructs the fleet from files alone, so the
+tests write the files by hand and assert the view. The real-subprocess
+end-to-end twin is scripts/check_fleet_report.py (CI quick gate).
+"""
+import json
+import re
+import time
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu import fleet_report
+from video_features_tpu.telemetry.jsonl import write_json_atomic
+
+pytestmark = pytest.mark.quick
+
+NOW = 1_700_000_000.0
+
+
+def _hb(host_id, t, *, final=False, interval=30.0, run_id="run-a",
+        done=3, fleet=None, serve=None, cache=None):
+    hb = {"schema": "vft.heartbeat/1", "run_id": run_id,
+          "host": "synth", "host_id": host_id, "pid": 1,
+          "feature_type": "resnet", "time": t, "started_time": t - 60,
+          "uptime_s": 60.0, "interval_s": interval, "final": final,
+          "videos": {"done": done}, "videos_done": done,
+          "videos_per_s": 0.5, "last_video": "x.mp4"}
+    if fleet is not None:
+        hb["fleet"] = fleet
+    if serve is not None:
+        hb["serve"] = serve
+    if cache is not None:
+        hb["cache"] = cache
+    return hb
+
+
+def _write_hb(dirp: Path, hb: dict) -> Path:
+    dirp.mkdir(parents=True, exist_ok=True)
+    p = dirp / f"_heartbeat_{hb['host_id']}.json"
+    write_json_atomic(p, hb)
+    return p
+
+
+def test_aggregate_classifies_live_stale_final_prior(tmp_path):
+    root = tmp_path / "out"
+    _write_hb(root, _hb("live-1", NOW - 5))
+    _write_hb(root, _hb("stale-1", NOW - 200))          # 200s > 3*30s
+    _write_hb(root, _hb("done-1", NOW - 400, final=True))
+    # prior run: the dir's manifest names a newer run, and the heartbeat
+    # both mismatches its run_id AND predates its started_time
+    _write_hb(root, _hb("prior-1", NOW - 500, run_id="old-run"))
+    write_json_atomic(root / "_run.json",
+                      {"run_id": "run-a", "started_time": NOW - 100})
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    assert agg["n_hosts"] == {"live": 1, "stalled": 1, "finished": 1,
+                              "prior_run": 1, "unreadable": 0}
+    text = "\n".join(fleet_report.render(agg))
+    assert "live-1: alive" in text
+    assert "stale-1: STALLED?" in text
+    assert "done-1: FINISHED" in text
+    assert "prior-1: PRIOR RUN" in text and "ignored" in text
+    # the prior-run host's tallies stay out of the aggregates: only the
+    # three current hosts' videos_done are live rows
+    by_state = {e["hb"]["host_id"]: e["state"] for e in agg["hosts"]
+                if e["hb"] and not e["prior_run"]}
+    assert set(by_state) == {"live-1", "stale-1", "done-1"}
+
+
+def test_straggler_flag_and_queue_counts(tmp_path):
+    root = tmp_path / "out"
+    q = {"pending": 0, "claimed": 1, "done": 5}
+    _write_hb(root, _hb("busy-1", NOW - 2, fleet={
+        "mode": "queue", "active_claims": 1, "queue": q,
+        "claimed": 4, "done": 3, "stolen": 1, "reclaimed": 0}))
+    _write_hb(root, _hb("idle-1", NOW - 2, fleet={
+        "mode": "queue", "active_claims": 0, "queue": q,
+        "claimed": 2, "done": 2, "stolen": 0, "reclaimed": 0}))
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    assert agg["stragglers"] == ["busy-1"]
+    text = "\n".join(fleet_report.render(agg))
+    assert "busy-1" in text and "STRAGGLER" in text
+    assert "idle-1" in text
+    # queue counts fall back to the freshest heartbeat's fleet section
+    assert agg["queue"] == q
+    # ... unless the _queue dir itself exists (ground truth wins)
+    for d, n in (("pending", 2), ("done", 1)):
+        dd = root / "_queue" / d
+        dd.mkdir(parents=True)
+        for i in range(n):
+            (dd / f"it{i}.json").write_text("{}")
+    (root / "_queue" / "claimed" / "busy-1").mkdir(parents=True)
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    assert agg["queue"] == {"pending": 2, "done": 1, "quarantined": 0,
+                            "claimed": 0}
+
+
+def test_serve_slo_and_cache_aggregation(tmp_path):
+    root = tmp_path / "spool"
+    serve_a = {"state": "ready", "pending": 0, "inflight": 1,
+               "requests": {"done": 90}, "active_requests": ["r1"],
+               "slo": {"slo_s": 2.0, "requests": 90, "violations": 9,
+                       "attainment_pct": 90.0,
+                       "queue_wait": {"p50": 0.01, "p95": 0.2,
+                                      "p99": 0.4},
+                       "service": {"p50": 0.5, "p95": 1.5, "p99": 3.0}}}
+    serve_b = {"state": "ready", "pending": 2, "inflight": 0,
+               "requests": {"done": 10}, "active_requests": [],
+               "slo": {"slo_s": 2.0, "requests": 10, "violations": 1,
+                       "attainment_pct": 90.0,
+                       "queue_wait": {"p50": 0.01, "p95": 0.1,
+                                      "p99": 0.2},
+                       "service": {"p50": 0.4, "p95": 1.0, "p99": 2.0}}}
+    _write_hb(root, _hb("srv-1", NOW - 2, serve=serve_a,
+                        cache={"hits": {"resnet": 10},
+                               "misses": {"resnet": 30},
+                               "bypasses": {}, "hit_rate": 0.25}))
+    _write_hb(root, _hb("srv-2", NOW - 2, serve=serve_b,
+                        cache={"hits": {"resnet": 5, "clip": 5},
+                               "misses": {"resnet": 0},
+                               "bypasses": {"resnet": 2},
+                               "hit_rate": 1.0}))
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    t = agg["serve"]["totals"]
+    assert t == {"requests": 100, "violations": 10,
+                 "attainment_pct": 90.0}
+    assert agg["cache"]["hits"] == 20 and agg["cache"]["misses"] == 30
+    assert agg["cache"]["hit_rate"] == 0.4
+    text = "\n".join(fleet_report.render(agg))
+    assert "attainment=90.0%" in text
+    assert "service p50/p95/p99=0.5/1.5/3.0s" in text
+
+
+def test_stitch_aligns_offset_anchors(tmp_path):
+    """Two traces whose recorders started 5 s apart must land on ONE
+    wall-clock timeline: the later host's events shift by +5e6 µs, each
+    host gets its own pid lane titled with its host_id, and every
+    event keeps its per-ph required fields."""
+    from video_features_tpu.telemetry.trace import (REQUIRED_X_FIELDS,
+                                                    TRACE_SCHEMA)
+
+    def doc(host_id, anchor, ts):
+        return {
+            "traceEvents": [
+                {"ph": "M", "name": "process_name", "pid": 7,
+                 "args": {"name": "vft-host synth"}},
+                {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+                 "args": {"name": "MainThread"}},
+                {"ph": "X", "name": "video_attempt", "ts": ts,
+                 "dur": 10.0, "pid": 7, "tid": 1, "cat": "host"},
+            ],
+            "otherData": {"schema": TRACE_SCHEMA, "host": "synth",
+                          "host_id": host_id, "pid": 7,
+                          "start_unix": anchor},
+        }
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "_trace_host-a.json").write_text(
+        json.dumps(doc("host-a", 1000.0, 100.0)))
+    (tmp_path / "b" / "_trace_host-b.json").write_text(
+        json.dumps(doc("host-b", 1005.0, 100.0)))
+    out, merged = fleet_report.stitch(str(tmp_path))
+    assert out == str(tmp_path / "_trace_fleet.json")
+    other = merged["otherData"]
+    assert other["aligned"] is True and other["anchor_unix"] == 1000.0
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    by_host = {}
+    names = {e["pid"]: e["args"]["name"]
+             for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for e in xs:
+        by_host[names[e["pid"]]] = e
+        for f in REQUIRED_X_FIELDS:
+            assert f in e, f"stitched event lost required field {f}"
+    # host-a keeps its own timeline; host-b shifts by the 5 s anchor gap
+    assert by_host["host-a"]["ts"] == 100.0
+    assert by_host["host-b"]["ts"] == 100.0 + 5e6
+    assert by_host["host-a"]["pid"] != by_host["host-b"]["pid"]
+    # the stitched OUTPUT file is never re-ingested as an input
+    out2, merged2 = fleet_report.stitch(str(tmp_path))
+    assert len(merged2["otherData"]["hosts"]) == 2
+
+
+def test_stitch_unanchored_falls_back(tmp_path):
+    (tmp_path / "_trace.json").write_text(json.dumps({
+        "traceEvents": [{"ph": "X", "name": "decode", "ts": 1.0,
+                         "dur": 2.0, "pid": 1, "tid": 1}],
+        "otherData": {"host": "old"}}))
+    out, merged = fleet_report.stitch(str(tmp_path))
+    other = merged["otherData"]
+    assert other["aligned"] is False
+    assert other["unanchored"], "anchorless trace not flagged"
+    assert [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+
+
+def test_watch_single_pass_and_prom_parses(tmp_path, capsys):
+    root = tmp_path / "out"
+    _write_hb(root, _hb("live-1", time.time()))
+    # --watch --iterations 1: exactly one pass, then exit 0 (no sleep
+    # loop to kill — the scripted/test form of the live view)
+    rc = fleet_report.main([str(root), "--watch", "--iterations", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("fleet report:") == 1
+    assert "live-1" in out
+
+    prom = tmp_path / "fleet.prom"
+    rc = fleet_report.main([str(root), "--prom", str(prom)])
+    assert rc == 0
+    text = prom.read_text()
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+$')
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        assert line_re.match(line), f"unparseable prom line: {line!r}"
+    assert "vft_fleet_hosts{state=\"live\"} 1" in text
+    assert 'vft_fleet_videos_done{host_id="live-1"} 3' in text
+
+
+def test_find_request_across_artifacts(tmp_path):
+    root = tmp_path / "out"
+    root.mkdir()
+    rid = "reqabc123"
+    with open(root / "_telemetry.jsonl", "w") as f:
+        f.write(json.dumps({"video": "a.mp4", "status": "done",
+                            "request_id": rid}) + "\n")
+        f.write(json.dumps({"video": "b.mp4", "status": "done",
+                            "request_id": "other"}) + "\n")
+    with open(root / "_health.jsonl", "w") as f:
+        f.write(json.dumps({"video": "a.mp4", "key": "resnet",
+                            "sig": "ff" * 32, "request_id": rid}) + "\n")
+    (root / "done").mkdir()
+    (root / "done" / f"{rid}.json").write_text(json.dumps({"id": rid}))
+    (root / "_trace_h1.json").write_text(json.dumps({
+        "traceEvents": [
+            {"ph": "X", "name": "serve.request", "ts": 0, "dur": 1,
+             "pid": 1, "tid": 1, "args": {"id": rid}},
+            {"ph": "X", "name": "video_attempt", "ts": 0, "dur": 1,
+             "pid": 1, "tid": 1, "args": {"request": rid}}],
+        "otherData": {}}))
+    hits = fleet_report.find_request(str(root), rid)
+    kinds = sorted(h.split()[0] for h in hits)
+    assert kinds == ["health", "span", "spool", "trace", "trace"], hits
+    # and the CLI form renders them
+    assert fleet_report.main([str(root), "--request", rid]) == 0
+    assert fleet_report.main([str(root), "--request", "missing"]) == 1

@@ -50,7 +50,9 @@ def test_serve_roundtrip_warm_and_cache_hit(sample_video, tmp_path):
     argv, spool, vids = _base_args(tmp_path, sample_video)
     assert serve.server_state(spool) == {"state": "absent"}
     t = threading.Thread(
-        target=serve.serve_main, args=(argv + ["serve_max_requests=2"],),
+        target=serve.serve_main,
+        args=(argv + ["serve_max_requests=2", "health=true",
+                      "trace=true"],),
         daemon=True)
     t.start()
     # request 1 pays the cold tax (compile + decode); clip0 lands in the
@@ -86,6 +88,35 @@ def test_serve_roundtrip_warm_and_cache_hit(sample_video, tmp_path):
     hb = json.loads(next(Path(spool).glob("_heartbeat_*.json")).read_text())
     assert hb["cache"]["hits"] == {"resnet": 1}
     assert hb["serve"]["requests"]["done"] == 2
+    # SLO block present even with no serve_slo_s set: percentiles off
+    # the bounded histograms, violation counting disabled
+    slo = hb["serve"]["slo"]
+    assert slo["slo_s"] is None and slo["requests"] == 2
+    assert slo["violations"] == 0 and slo["attainment_pct"] == 100.0
+    assert slo["service"]["p95"] is not None
+
+    # request-scoped correlation end-to-end (ISSUE 10): the id returned
+    # by submit_request is findable in the span, health and trace
+    # records the request produced — the spool roundtrip IS the join key
+    spans = [json.loads(line) for line in
+             (Path(spool) / "_telemetry.jsonl").read_text().splitlines()]
+    assert {s["request_id"] for s in spans} == {r1, r2}
+    health = [json.loads(line) for line in
+              next(out_root.rglob("_health.jsonl")).read_text()
+              .splitlines()]
+    assert {h["request_id"] for h in health} == {r1, r2}
+    trace_doc = json.loads(
+        next(Path(spool).glob("_trace_*.json")).read_text())
+    tagged = {e["args"].get("id") or e["args"].get("request")
+              for e in trace_doc["traceEvents"]
+              if isinstance(e.get("args"), dict)
+              and e["name"] in ("serve.request", "video_attempt")}
+    assert {r1, r2} <= tagged
+    # ... and vft-fleet --request joins them all from the artifacts
+    from video_features_tpu import fleet_report
+    hits = fleet_report.find_request(str(tmp_path), r1)
+    kinds = {h.split()[0] for h in hits}
+    assert {"span", "health", "trace", "spool"} <= kinds, hits
 
 
 def test_admission_control_rejects_overflow(sample_video, tmp_path):
@@ -110,6 +141,92 @@ def test_admission_control_rejects_overflow(sample_video, tmp_path):
     assert set(rejected) == set(rids[2:])
     for resp in (serve.read_response(spool, r) for r in rejected):
         assert "serve_max_pending" in resp["error"]
+
+
+def test_slo_accounting_percentiles_violations_bounded(sample_video,
+                                                       tmp_path):
+    """The SLO ledger (ISSUE 10): queue-wait/service split into the
+    fixed-bucket histograms, violations counted against serve_slo_s on
+    wait+service, attainment % in the serve section — and the recent
+    window BOUNDED (the unbounded `_request_latencies` list this
+    replaced grew for the life of the server)."""
+    from video_features_tpu.config import (load_config, parse_dotlist,
+                                           sanity_check)
+    argv, spool, vids = _base_args(tmp_path, sample_video, n_copies=1)
+    cfg = load_config("resnet", parse_dotlist(argv))
+    cfg.cache = False
+    cfg.serve_slo_s = 1.0
+    sanity_check(cfg, require_videos=False)
+    loop = serve.ServeLoop(cfg, out_root=str(tmp_path / "out"))
+
+    # before any request: empty-but-well-formed SLO block
+    slo = loop._serve_section()["slo"]
+    assert slo == {"slo_s": 1.0, "requests": 0, "violations": 0,
+                   "attainment_pct": None,
+                   "queue_wait": {"p50": None, "p95": None, "p99": None},
+                   "service": {"p50": None, "p95": None, "p99": None}}
+
+    # 90 fast requests + 10 slow: wait+service > 1.0s only for the slow
+    for _ in range(90):
+        assert not loop._account_request(0.01, 0.1)
+    for _ in range(10):
+        assert loop._account_request(0.6, 0.9)  # 1.5 > slo_s
+    slo = loop._serve_section()["slo"]
+    assert slo["requests"] == 100 and slo["violations"] == 10
+    assert slo["attainment_pct"] == 90.0
+    # percentiles: p50 in the fast band, p95+ in the slow band (bucket
+    # upper-bound interpolation, telemetry/metrics.py)
+    assert slo["service"]["p50"] <= 0.25
+    assert slo["service"]["p95"] >= 0.5
+    assert slo["queue_wait"]["p50"] <= 0.025
+    # the recent window is a fixed-size deque, not an unbounded list
+    assert len(loop._recent) == 32
+    assert not hasattr(loop, "_request_latencies")
+    # violation counter exported for the prometheus/manifest path
+    reg = loop.recorder.registry
+    assert reg.counter("vft_serve_slo_violations_total").value == 10
+    loop.recorder.close()
+
+
+def test_telemetry_report_serve_line_and_fail_on_slo(tmp_path):
+    """telemetry_report renders the per-host serve/SLO lines off the
+    heartbeat and --fail-on-slo turns violations into exit 1 (ISSUE 10
+    satellite) — the CI/canary gate on serving latency."""
+    import sys
+    import time
+    from pathlib import Path as _P
+
+    from video_features_tpu.telemetry.jsonl import write_json_atomic
+    sys.path.insert(0, str(_P(__file__).resolve().parent.parent
+                           / "scripts"))
+    import telemetry_report
+
+    def hb(violations):
+        return {"host_id": "srv-1", "time": time.time(),
+                "interval_s": 30.0, "final": False, "videos_done": 5,
+                "serve": {
+                    "state": "ready", "pending": 1, "inflight": 2,
+                    "requests": {"done": 20, "rejected": 1},
+                    "slo": {"slo_s": 2.0, "requests": 20,
+                            "violations": violations,
+                            "attainment_pct": 100.0 - 5.0 * violations,
+                            "queue_wait": {"p50": 0.01, "p95": 0.2,
+                                           "p99": 0.3},
+                            "service": {"p50": 0.5, "p95": 1.5,
+                                        "p99": 1.9}}}}
+
+    out = tmp_path / "spool"
+    out.mkdir()
+    write_json_atomic(out / "_heartbeat_srv-1.json", hb(3))
+    text = "\n".join(telemetry_report.render_heartbeats(
+        [str(out / "_heartbeat_srv-1.json")], time.time()))
+    assert "serve: ready" in text and "rejected=1" in text
+    assert "slo: service p50/p95/p99=0.5/1.5/1.9s" in text
+    assert "violations=3" in text and "attainment=85.0%" in text
+    assert telemetry_report.main([str(out), "--fail-on-slo"]) == 1
+    # zero violations (or no objective): the gate passes
+    write_json_atomic(out / "_heartbeat_srv-1.json", hb(0))
+    assert telemetry_report.main([str(out), "--fail-on-slo"]) == 0
 
 
 def test_dead_server_claims_reclaimed(sample_video, tmp_path):

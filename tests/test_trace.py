@@ -269,6 +269,46 @@ def test_trace_report_merge_host_device(tmp_path):
     assert min(e["ts"] for e in xs) == 0  # both rebased
 
 
+def test_trace_report_merge_wall_clock_anchors(tmp_path):
+    """Two vft traces whose recorders started 3 s apart must merge onto
+    shared WALL time (ISSUE 10 satellite): each keeps its internal ts
+    and shifts by its otherData.start_unix offset against the earliest
+    anchor — not both silently pinned to t=0, which misaligns any two
+    captures not started together."""
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    a_dir.mkdir(), b_dir.mkdir()
+    host = a_dir / "_trace.json"
+    host.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "decode", "ts": 100.0, "dur": 10.0,
+         "pid": 7, "tid": 1}],
+        "otherData": {"schema": "vft.trace/1", "start_unix": 1000.0}}))
+    other = b_dir / "_trace.json"
+    other.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "decode", "ts": 100.0, "dur": 10.0,
+         "pid": 7, "tid": 1}],
+        "otherData": {"schema": "vft.trace/1", "start_unix": 1003.0}}))
+    p = _report([host, "--merge", b_dir,
+                 "--out", tmp_path / "merged.json"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    merged = json.load(open(tmp_path / "merged.json"))
+    assert merged["otherData"]["aligned"] is True
+    xs = sorted((e["ts"] for e in merged["traceEvents"]
+                 if e.get("ph") == "X"))
+    # host anchored at the minimum keeps ts=100; the +3 s capture shifts
+    assert xs == [100.0, 100.0 + 3e6]
+    # anchorless second input (a jax capture): legacy both-to-t=0 path
+    (b_dir / "_trace.json").write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "fusion.1", "ts": 9_000_000, "dur": 50,
+         "pid": 3, "tid": 2}]}))
+    p2 = _report([host, "--merge", b_dir,
+                  "--out", tmp_path / "merged2.json"])
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+    merged2 = json.load(open(tmp_path / "merged2.json"))
+    assert merged2["otherData"]["aligned"] is False
+    assert min(e["ts"] for e in merged2["traceEvents"]
+               if e.get("ph") == "X") == 0
+
+
 def test_trace_report_truncated_file_clear_error(tmp_path):
     torn = tmp_path / "_trace.json"
     torn.write_text('{"traceEvents": [{"ph": "X", "name": "dec')  # torn

@@ -91,7 +91,7 @@ NON_SEMANTIC_KEYS = frozenset({
     # serve-mode knobs (serve.py): spool plumbing, not feature values
     "spool_dir", "serve_max_pending", "serve_poll_interval_s",
     "serve_idle_exit_s", "serve_max_requests", "serve_workers",
-    "serve_warmup_video",
+    "serve_warmup_video", "serve_slo_s",
     # sink format changes the FILE, not the feature values; entries store
     # arrays and are written through whichever sink the run uses
     "on_extraction", "show_pred",

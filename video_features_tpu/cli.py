@@ -277,7 +277,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     tracer = None
     if bool(args.get("trace", False)):
         from .telemetry.trace import TraceRecorder
-        tracer = TraceRecorder(out_root).start()
+        # fleet=queue workers co-own out_root: each writes its own
+        # _trace_{host_id}.json (single-writer dirs keep _trace.json) —
+        # otherwise the last worker to exit would overwrite every other
+        # host's timeline, and vft-fleet --stitch needs them all
+        tracer = TraceRecorder(
+            out_root,
+            host_id=(host_id if fleet_mode == "queue"
+                     and recorder is not None else None)).start()
 
     # Work-stealing fleet queue (fleet=queue, parallel/queue.py): instead
     # of owning a fixed hash shard, this host claims videos one at a time

@@ -378,6 +378,22 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
                          "(gate joining hosts on a re-extracted slice, "
                          "docs/fleet.md)")
 
+    # serve SLO key (serve.py): the per-request latency objective in
+    # seconds, measured queue-wait + service; a typo'd objective must
+    # fail at launch, not silently count zero violations
+    slo = args.get("serve_slo_s")
+    if slo is not None:
+        try:
+            slo_f = float(slo)
+        except (TypeError, ValueError):
+            raise ValueError(f"serve_slo_s={slo!r}: need a float > 0 in "
+                             "seconds, or null to disable violation "
+                             "counting (docs/serving.md)") from None
+        if slo_f <= 0:
+            raise ValueError(f"serve_slo_s={slo!r}: need a float > 0 in "
+                             "seconds, or null to disable violation "
+                             "counting (docs/serving.md)")
+
     # fault-injection plan (utils/inject.py): the full plan grammar is
     # parsed at launch, so a typo'd site/fault/trigger fails HERE with
     # the offending clause named — never silently runs a chaos-free

@@ -1,0 +1,672 @@
+"""``vft-fleet``: one live view of the whole fleet from its artifacts.
+
+The per-run report tools each read ONE output dir: telemetry_report
+renders one host's manifest + heartbeats, trace_report one host's
+timeline. A fleet — N ``fleet=queue`` workers co-owning an out_root, or
+N ``vft-serve`` processes sharing a spool — has no single place an
+operator can ask "is everyone alive, who is the straggler, what is the
+cache doing, are we inside the SLO". This module is that place: point it
+at the shared root and it merges every host's heartbeats, the queue
+counts, cache hit rates, per-family throughput (from span records) and
+serve SLO attainment into one report, flagging the host the rest of the
+fleet idles behind.
+
+    vft-fleet /shared/out                      # one-shot report
+    vft-fleet /shared/out --watch              # live refresh (2s)
+    vft-fleet /shared/out --prom /var/lib/node_exporter/vft_fleet.prom
+    vft-fleet /shared/out --stitch             # one Perfetto file, all hosts
+    vft-fleet /shared/out --request 3f2a9c1b   # everything one request touched
+
+Everything is reconstructed from artifacts (heartbeats, ``_run.json``,
+``_telemetry.jsonl``, ``_health.jsonl``, ``_trace.json``, the ``_queue``
+and spool dirs) — no live process, agent or scrape endpoint required,
+exactly the discipline of the per-run tools. Works on a dead fleet too.
+
+**Stitching** (``--stitch``): every host's ``_trace.json`` under the
+root merges into ONE Chrome-trace file with one process lane per host,
+aligned on each trace's **wall-clock anchor** (``otherData.start_unix``,
+stamped by telemetry/trace.py at recorder start): event time becomes
+``anchor + ts``, rebased to the earliest anchor — real cross-host time,
+so a steal on host B renders *after* the lease expiry on host A that
+caused it. A trace without an anchor (pre-anchor artifacts) falls back
+to offset 0 and is flagged in ``otherData.unanchored``.
+
+**Request lookup** (``--request``): the serve plane stamps every span
+record, health digest, failure-journal entry, trace span and response
+with the originating request id (telemetry/context.py); this flag greps
+the fleet's artifacts for one id and prints every record it produced,
+wherever it ran.
+
+Installed as the ``vft-fleet`` console script;
+``scripts/fleet_report.py`` is the bare-checkout wrapper. See
+docs/observability.md "One view of the fleet".
+"""
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from .telemetry.heartbeat import (HEARTBEAT_GLOB, STALL_INTERVALS,
+                                  matches_run)
+from .telemetry.jsonl import read_jsonl
+from .telemetry.metrics import prometheus_text
+from .telemetry.trace import TRACE_FILENAME, TRACE_OUTPUT_NAMES
+
+SPANS_FILENAME = "_telemetry.jsonl"
+MANIFEST_FILENAME = "_run.json"
+HEALTH_FILENAME = "_health.jsonl"
+FAILURES_FILENAME = "_failures.jsonl"
+
+#: stitched-trace format tag (otherData.schema)
+STITCH_SCHEMA = "vft.trace_fleet/1"
+
+#: pid base for stitched host lanes: each host's events are remapped to
+#: a distinct pid so Perfetto renders one process group per host
+STITCH_PID_BASE = 1000
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 5400:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def fleet_stragglers(hbs: List[dict], now: float) -> set:
+    """host_ids binding the fleet: a host still holding active fleet
+    claims while the shared queue's pending is empty AND at least one
+    other live fleet host sits idle — everyone else is waiting on it
+    (the per-host ``fleet.idle_wait`` trace spans are the same signal in
+    time). Shared by telemetry_report.py and the fleet aggregator."""
+    live = []
+    for hb in hbs:
+        fl = hb.get("fleet")
+        if not isinstance(fl, dict) or hb.get("final"):
+            continue
+        interval = float(hb.get("interval_s", 30.0) or 30.0)
+        if now - float(hb.get("time", 0)) > STALL_INTERVALS * interval:
+            continue
+        live.append((str(hb.get("host_id")), fl))
+    if len(live) < 2:
+        return set()
+    idle = [h for h, fl in live if not fl.get("active_claims")]
+    if not idle:
+        return set()
+    return {h for h, fl in live
+            if fl.get("active_claims")
+            and not (fl.get("queue") or {}).get("pending", 0)}
+
+
+# -- collection ---------------------------------------------------------------
+
+def collect_heartbeats(root: str, now: Optional[float] = None) -> List[dict]:
+    """Every heartbeat under ``root`` (recursively — fleet workers home
+    theirs at the out_root, multi-family runs at the common root, serve
+    at the spool), classified against its own directory's manifest:
+
+    ``{"path", "dir", "hb", "state", "age_s", "prior_run"}`` with state
+    one of ``live`` / ``STALLED`` / ``FINISHED`` / ``unreadable``.
+    Prior-run files (a reused output dir; heartbeat demonstrably from an
+    older run than the sibling manifest) are flagged, not dropped — the
+    renderer shows them as ignored, the aggregates skip them."""
+    now = time.time() if now is None else now
+    out: List[dict] = []
+    seen: set = set()
+    root_p = Path(root)
+    paths = sorted(root_p.rglob(HEARTBEAT_GLOB))
+    # rglob misses nothing below, but the root itself may BE a file list
+    for p in paths:
+        rp = str(p.resolve())
+        if rp in seen:
+            continue
+        seen.add(rp)
+        entry: Dict[str, Any] = {"path": str(p), "dir": str(p.parent)}
+        hb = _load_json(str(p))
+        if hb is None:
+            entry.update(hb=None, state="unreadable", age_s=None,
+                         prior_run=False)
+            out.append(entry)
+            continue
+        man = _load_json(os.path.join(str(p.parent), MANIFEST_FILENAME))
+        prior = man is not None and not matches_run(
+            hb, man.get("run_id"), man.get("started_time"))
+        age = max(0.0, now - float(hb.get("time", now) or now))
+        interval = float(hb.get("interval_s", 30.0) or 30.0)
+        if hb.get("final"):
+            state = "FINISHED"
+        elif age > STALL_INTERVALS * interval:
+            state = "STALLED"
+        else:
+            state = "live"
+        entry.update(hb=hb, state=state, age_s=round(age, 3),
+                     prior_run=bool(prior))
+        out.append(entry)
+    return out
+
+
+def collect_family_throughput(root: str) -> Dict[str, dict]:
+    """Per-family tallies off every ``_telemetry.jsonl`` under the root:
+    records, done/error counts, mean seconds per video — the
+    whole-fleet per-family throughput no single host's heartbeat can
+    see."""
+    fams: Dict[str, dict] = {}
+    for path in sorted(Path(root).rglob(SPANS_FILENAME)):
+        for rec in read_jsonl(path):
+            fam = str(rec.get("feature_type") or "?")
+            f = fams.setdefault(fam, {"records": 0, "done": 0, "error": 0,
+                                      "wall_s": 0.0})
+            f["records"] += 1
+            st = rec.get("status")
+            if st == "done":
+                f["done"] += 1
+                f["wall_s"] += float(rec.get("wall_s") or 0.0)
+            elif st in ("error", "quarantined"):
+                f["error"] += 1
+    for f in fams.values():
+        f["s_per_video"] = (round(f["wall_s"] / f["done"], 3)
+                            if f["done"] else None)
+        f["wall_s"] = round(f["wall_s"], 3)
+    return fams
+
+
+def _queue_counts(root: str, entries: List[dict]) -> Optional[dict]:
+    """Fleet-queue counts: preferred from the ``_queue`` dir itself (the
+    ground truth both workers and this tool read), falling back to the
+    freshest live heartbeat's ``fleet.queue`` section."""
+    qroot = os.path.join(str(root), "_queue")
+    if os.path.isdir(qroot):
+        counts = {}
+        for d in ("pending", "done", "quarantined"):
+            try:
+                counts[d] = sum(1 for n in os.listdir(
+                    os.path.join(qroot, d)) if n.endswith(".json"))
+            except OSError:
+                counts[d] = 0
+        claimed = 0
+        try:
+            for h in os.listdir(os.path.join(qroot, "claimed")):
+                try:
+                    claimed += sum(1 for n in os.listdir(
+                        os.path.join(qroot, "claimed", h))
+                        if n.endswith(".json"))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        counts["claimed"] = claimed
+        return counts
+    best = None
+    for e in entries:
+        hb = e.get("hb") or {}
+        fl = hb.get("fleet")
+        if not isinstance(fl, dict) or e.get("prior_run"):
+            continue
+        if best is None or float(hb.get("time", 0)) > \
+                float((best.get("hb") or {}).get("time", 0)):
+            best = e
+    if best is None:
+        return None
+    return dict(((best.get("hb") or {}).get("fleet") or {})
+                .get("queue") or {})
+
+
+def aggregate(root: str, now: Optional[float] = None) -> dict:
+    """The one-view fleet snapshot: everything the renderer, the prom
+    exporter and the tests consume, as plain JSON-safe data."""
+    now = time.time() if now is None else now
+    entries = collect_heartbeats(root, now=now)
+    current = [e for e in entries
+               if e.get("hb") is not None and not e["prior_run"]]
+    hbs = [e["hb"] for e in current]
+    stragglers = fleet_stragglers(hbs, now)
+
+    cache = {"hits": 0, "misses": 0, "bypasses": 0}
+    by_family_cache: Dict[str, Dict[str, int]] = {}
+    slo_hosts: List[dict] = []
+    slo_totals = {"requests": 0, "violations": 0}
+    for e in current:
+        hb = e["hb"]
+        ca = hb.get("cache") or {}
+        for k in ("hits", "misses", "bypasses"):
+            per = ca.get(k) or {}
+            cache[k] += sum(int(v) for v in per.values())
+            for fam, v in per.items():
+                by_family_cache.setdefault(fam, {}).setdefault(k, 0)
+                by_family_cache[fam][k] += int(v)
+        serve = hb.get("serve")
+        if isinstance(serve, dict):
+            slo = serve.get("slo") or {}
+            slo_hosts.append({
+                "host_id": hb.get("host_id"), "state": serve.get("state"),
+                "hb_state": e["state"],
+                "pending": serve.get("pending"),
+                "inflight": serve.get("inflight"),
+                "active_requests": serve.get("active_requests") or [],
+                "requests": serve.get("requests") or {}, "slo": slo})
+            slo_totals["requests"] += int(slo.get("requests") or 0)
+            slo_totals["violations"] += int(slo.get("violations") or 0)
+    consulted = cache["hits"] + cache["misses"]
+    cache["hit_rate"] = (round(cache["hits"] / consulted, 4)
+                         if consulted else None)
+    n_req = slo_totals["requests"]
+    slo_totals["attainment_pct"] = (
+        round(100.0 * (n_req - slo_totals["violations"]) / n_req, 2)
+        if n_req else None)
+
+    return {
+        "root": str(root),
+        "time": now,
+        "hosts": entries,
+        "n_hosts": {
+            "live": sum(1 for e in current if e["state"] == "live"),
+            "stalled": sum(1 for e in current if e["state"] == "STALLED"),
+            "finished": sum(1 for e in current
+                            if e["state"] == "FINISHED"),
+            "prior_run": sum(1 for e in entries if e["prior_run"]),
+            "unreadable": sum(1 for e in entries
+                              if e["state"] == "unreadable"),
+        },
+        "stragglers": sorted(stragglers),
+        "queue": _queue_counts(root, entries),
+        "cache": cache,
+        "cache_by_family": by_family_cache,
+        "families": collect_family_throughput(root),
+        "serve": {"hosts": slo_hosts, "totals": slo_totals},
+    }
+
+
+# -- rendering ----------------------------------------------------------------
+
+def render(agg: dict) -> List[str]:
+    lines = [f"fleet report: {agg['root']}"]
+    n = agg["n_hosts"]
+    lines.append(
+        f"== hosts ==  {n['live']} live / {n['stalled']} stalled / "
+        f"{n['finished']} finished"
+        + (f" / {n['prior_run']} prior-run (ignored)"
+           if n["prior_run"] else "")
+        + (f" / {n['unreadable']} unreadable" if n["unreadable"] else ""))
+    for e in agg["hosts"]:
+        hb = e.get("hb")
+        if hb is None:
+            lines.append(f"  {os.path.basename(e['path'])}: unreadable")
+            continue
+        if e["prior_run"]:
+            lines.append(f"  {hb.get('host_id')}: PRIOR RUN "
+                         f"(run_id={hb.get('run_id')}) — ignored")
+            continue
+        tag = {"live": "alive", "STALLED": "STALLED?",
+               "FINISHED": "FINISHED"}[e["state"]]
+        line = (f"  {hb.get('host_id')}: {tag}  "
+                f"age={_fmt_age(e['age_s'])}  "
+                f"done={hb.get('videos_done', 0)}  "
+                f"videos/s={hb.get('videos_per_s')}")
+        fl = hb.get("fleet")
+        if isinstance(fl, dict):
+            line += (f"  [fleet claimed={fl.get('claimed', 0)} "
+                     f"done={fl.get('done', 0)} "
+                     f"stolen={fl.get('stolen', 0)} "
+                     f"active={fl.get('active_claims', 0)}]")
+        if str(hb.get("host_id")) in agg["stragglers"]:
+            line += "  STRAGGLER (fleet idle behind this host)"
+        lines.append(line)
+    if agg["queue"] is not None:
+        q = agg["queue"]
+        lines.append(
+            f"== fleet queue ==  pending={q.get('pending', 0)}  "
+            f"claimed={q.get('claimed', 0)}  done={q.get('done', 0)}"
+            + (f"  quarantined={q['quarantined']}"
+               if q.get("quarantined") else ""))
+    ca = agg["cache"]
+    if any(ca.get(k) for k in ("hits", "misses", "bypasses")):
+        lines.append(
+            f"== cache ==  hits={ca['hits']}  misses={ca['misses']}  "
+            f"bypasses={ca['bypasses']}"
+            + (f"  hit_rate={ca['hit_rate']}"
+               if ca.get("hit_rate") is not None else ""))
+    fams = agg["families"]
+    if fams:
+        lines.append("== per-family throughput (fleet-wide spans) ==")
+        for fam, f in sorted(fams.items()):
+            lines.append(
+                f"  {fam:<10} done={f['done']:<6} error={f['error']:<4}"
+                + (f" {f['s_per_video']}s/video"
+                   if f.get("s_per_video") is not None else ""))
+    serve = agg["serve"]
+    if serve["hosts"]:
+        t = serve["totals"]
+        lines.append(
+            f"== serve SLO ==  requests={t['requests']}  "
+            f"violations={t['violations']}"
+            + (f"  attainment={t['attainment_pct']}%"
+               if t.get("attainment_pct") is not None else ""))
+        for h in serve["hosts"]:
+            slo = h["slo"]
+            svc = slo.get("service") or {}
+            qw = slo.get("queue_wait") or {}
+            line = (f"  {h['host_id']}: {h.get('state')}  "
+                    f"pending={h.get('pending')}  "
+                    f"inflight={h.get('inflight')}")
+            if slo.get("requests"):
+                line += (f"  service p50/p95/p99="
+                         f"{svc.get('p50')}/{svc.get('p95')}/"
+                         f"{svc.get('p99')}s"
+                         f"  wait p95={qw.get('p95')}s")
+                if slo.get("slo_s") is not None:
+                    line += (f"  slo={slo['slo_s']}s "
+                             f"violations={slo.get('violations', 0)}"
+                             f" attainment={slo.get('attainment_pct')}%")
+            lines.append(line)
+    return lines
+
+
+# -- prometheus export --------------------------------------------------------
+
+def build_prom_dump(agg: dict) -> dict:
+    """Fleet-level gauges in the telemetry/metrics.py dump shape, so
+    :func:`prometheus_text` renders them — one textfile for the whole
+    fleet next to the per-host ones telemetry_report exports."""
+    series: List[dict] = []
+
+    def g(name: str, value, **labels) -> None:
+        if value is None:
+            return
+        series.append({"name": name, "kind": "gauge",
+                       "labels": {k: str(v) for k, v in labels.items()},
+                       "value": float(value)})
+
+    for state, count in agg["n_hosts"].items():
+        g("vft_fleet_hosts", count, state=state)
+    for e in agg["hosts"]:
+        hb = e.get("hb")
+        if hb is None or e["prior_run"]:
+            continue
+        g("vft_fleet_videos_done", hb.get("videos_done", 0),
+          host_id=hb.get("host_id"))
+        g("vft_fleet_videos_per_s", hb.get("videos_per_s", 0.0),
+          host_id=hb.get("host_id"))
+    for h in agg["stragglers"]:
+        g("vft_fleet_straggler", 1, host_id=h)
+    if agg["queue"] is not None:
+        for k, v in agg["queue"].items():
+            g("vft_fleet_queue_items", v, bucket=k)
+    ca = agg["cache"]
+    for k in ("hits", "misses", "bypasses"):
+        g(f"vft_fleet_cache_{k}_total", ca.get(k, 0))
+    g("vft_fleet_cache_hit_rate", ca.get("hit_rate"))
+    for fam, f in agg["families"].items():
+        g("vft_fleet_family_done", f["done"], family=fam)
+        g("vft_fleet_family_errors", f["error"], family=fam)
+        g("vft_fleet_family_s_per_video", f.get("s_per_video"),
+          family=fam)
+    t = agg["serve"]["totals"]
+    g("vft_fleet_serve_requests_total", t["requests"])
+    g("vft_fleet_serve_slo_violations_total", t["violations"])
+    g("vft_fleet_serve_slo_attainment_pct", t.get("attainment_pct"))
+    for h in agg["serve"]["hosts"]:
+        svc = (h["slo"].get("service") or {})
+        for p in ("p50", "p95", "p99"):
+            g("vft_fleet_serve_service_seconds", svc.get(p),
+              host_id=h["host_id"], quantile=p)
+    return {"series": series}
+
+
+# -- trace stitching ----------------------------------------------------------
+
+def find_trace_files(root: str) -> List[Path]:
+    """Every trace artifact under ``root``: ``_trace.json``
+    (single-writer dirs) plus the per-host ``_trace_{host_id}.json``
+    fleet workers and serve siblings write — excluding stitched/merged
+    OUTPUT files, which must never feed back in as inputs."""
+    return [p for p in sorted(Path(root).rglob("_trace*.json"))
+            if p.name not in TRACE_OUTPUT_NAMES]
+
+
+def _host_label(doc: dict, trace_dir: str) -> str:
+    """Lane name for one host's trace: the recorder's own host_id stamp
+    when present, else the heartbeat host_id that shares the trace's
+    directory (pid-qualified, fleet-unique), else host+pid metadata."""
+    other = doc.get("otherData") or {}
+    if other.get("host_id"):
+        return str(other["host_id"])
+    pid = other.get("pid")
+    candidates = sorted(_glob.glob(os.path.join(trace_dir,
+                                                HEARTBEAT_GLOB)))
+    ids = []
+    for p in candidates:
+        hb = _load_json(p)
+        if hb is None:
+            continue
+        if pid is not None and hb.get("pid") == pid:
+            return str(hb.get("host_id"))
+        ids.append(str(hb.get("host_id")))
+    if len(ids) == 1:
+        return ids[0]
+    host = other.get("host") or "host"
+    return f"{host}-{pid}" if pid is not None else str(host)
+
+
+def stitch_traces(docs: List[Tuple[str, dict]]) -> dict:
+    """Merge N hosts' trace docs into one Chrome-trace file on one
+    wall-clock timeline.
+
+    ``docs`` is ``[(lane_label, doc), ...]``. Each doc's events keep
+    every field (the per-``ph`` required sets check_trace_schema pins)
+    except: ``ts`` shifts by the doc's wall-clock anchor offset against
+    the earliest anchor, and ``pid`` remaps to a per-host value so
+    Perfetto renders one process group per host, titled with the lane
+    label. Docs without an anchor stay at offset 0 (aligned to the
+    earliest-anchored host's start) and are listed in
+    ``otherData.unanchored``."""
+    anchors = [
+        (doc.get("otherData") or {}).get("start_unix") for _, doc in docs]
+    known = [float(a) for a in anchors if isinstance(a, (int, float))]
+    t0 = min(known) if known else None
+    events: List[dict] = []
+    hosts: List[dict] = []
+    unanchored: List[str] = []
+    for i, (label, doc) in enumerate(docs):
+        pid = STITCH_PID_BASE + i
+        anchor = anchors[i]
+        offset_us = (float(anchor) - t0) * 1e6 \
+            if isinstance(anchor, (int, float)) and t0 is not None else 0.0
+        if not isinstance(anchor, (int, float)):
+            unanchored.append(label)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": label}})
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the host lane title above
+            ev = dict(ev)
+            ev["pid"] = pid
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(ev["ts"] + offset_us, 3)
+            events.append(ev)
+        hosts.append({"host_id": label, "pid": pid,
+                      "start_unix": anchor,
+                      "offset_ms": round(offset_us / 1e3, 3)})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": STITCH_SCHEMA,
+            "hosts": hosts,
+            "anchor_unix": t0,
+            "unanchored": unanchored,
+            "aligned": bool(known) and not unanchored,
+        },
+    }
+
+
+def stitch(root: str, out_path: Optional[str] = None
+           ) -> Tuple[Optional[str], dict]:
+    """Find every ``_trace.json`` under ``root``, stitch, write.
+    Returns ``(written path or None, stitched doc)``."""
+    found = find_trace_files(root)
+    docs: List[Tuple[str, dict]] = []
+    for p in found:
+        doc = _load_json(str(p))
+        if doc is None or not isinstance(doc.get("traceEvents"), list):
+            print(f"vft-fleet: skipping unreadable/non-trace {p}",
+                  file=sys.stderr)
+            continue
+        docs.append((_host_label(doc, str(p.parent)), doc))
+    if not docs:
+        return None, {"traceEvents": [], "otherData": {
+            "schema": STITCH_SCHEMA, "hosts": [], "anchor_unix": None,
+            "unanchored": [], "aligned": False}}
+    merged = stitch_traces(docs)
+    out = out_path or os.path.join(str(root), "_trace_fleet.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    return out, merged
+
+
+# -- request lookup -----------------------------------------------------------
+
+def find_request(root: str, request_id: str) -> List[str]:
+    """Every artifact record one request produced, fleet-wide: span
+    records, health digests, failure-journal entries, trace spans, the
+    spool request/response files and fleet-queue claims carrying the id
+    (telemetry/context.py stamps them all in serve mode)."""
+    rid = str(request_id)
+    hits: List[str] = []
+    root_p = Path(root)
+    for name, kind in ((SPANS_FILENAME, "span"), (HEALTH_FILENAME,
+                       "health"), (FAILURES_FILENAME, "failure")):
+        for path in sorted(root_p.rglob(name)):
+            for rec in read_jsonl(path):
+                if rec.get("request_id") == rid or rec.get("id") == rid:
+                    tail = (f"status={rec.get('status')}" if kind == "span"
+                            else f"key={rec.get('key')} sig="
+                                 f"{str(rec.get('sig'))[:12]}"
+                            if kind == "health"
+                            else f"category={rec.get('category')}")
+                    hits.append(f"{kind}  {path}  video="
+                                f"{rec.get('video')}  {tail}")
+    for path in find_trace_files(root):
+        doc = _load_json(str(path))
+        if doc is None:
+            continue
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            args = ev.get("args") or {}
+            if rid in (args.get("request"), args.get("id"),
+                       args.get("request_id")):
+                hits.append(f"trace  {path}  {ev.get('name')} "
+                            f"ts={ev.get('ts')} dur={ev.get('dur')}")
+    for sub in ("requests", "done"):
+        for path in sorted(root_p.rglob(os.path.join(sub,
+                                                     f"{rid}.json"))):
+            hits.append(f"spool  {path}")
+    for path in sorted(root_p.rglob("*.json")):
+        if "_queue" not in path.parts and "claimed" not in path.parts:
+            continue
+        rec = _load_json(str(path))
+        if rec is not None and rid in (rec.get("request_id"),
+                                       rec.get("id")):
+            hits.append(f"claim  {path}  host={rec.get('host_id')}")
+    return hits
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one-view fleet report over a shared out_root/spool")
+    ap.add_argument("root", help="the fleet's shared output root (or a "
+                                 "vft-serve spool dir)")
+    ap.add_argument("--watch", action="store_true",
+                    help="live refresh until interrupted")
+    ap.add_argument("--every", type=float, default=2.0,
+                    help="--watch refresh period in seconds (default 2)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="--watch passes before exiting (0 = forever; "
+                         "1 = single-pass, for scripts/tests)")
+    ap.add_argument("--prom", metavar="FILE", default=None,
+                    help="write a fleet-level Prometheus textfile")
+    ap.add_argument("--stitch", nargs="?", const="", metavar="OUT",
+                    default=None,
+                    help="merge every host's _trace.json into one "
+                         "wall-clock-aligned Perfetto file (default "
+                         "{root}/_trace_fleet.json)")
+    ap.add_argument("--request", metavar="ID", default=None,
+                    help="print every artifact record one request id "
+                         "produced, fleet-wide")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"error: {args.root} is not a directory", file=sys.stderr)
+        return 2
+
+    if args.request:
+        hits = find_request(args.root, args.request)
+        if not hits:
+            print(f"request {args.request}: no artifacts under "
+                  f"{args.root}")
+            return 1
+        print(f"request {args.request}: {len(hits)} record(s)")
+        for h in hits:
+            print(f"  {h}")
+        return 0
+
+    passes = 0
+    while True:
+        agg = aggregate(args.root)
+        text = "\n".join(render(agg))
+        if args.watch and passes > 0:
+            # ANSI clear+home: the operator's top(1) for the fleet
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(text)
+        passes += 1
+        if not args.watch or (args.iterations and
+                              passes >= args.iterations):
+            break
+        try:
+            time.sleep(max(0.05, args.every))
+        except KeyboardInterrupt:
+            break
+
+    if args.prom:
+        dump = build_prom_dump(aggregate(args.root))
+        with open(args.prom, "w", encoding="utf-8") as f:
+            f.write(prometheus_text(dump))
+        print(f"prometheus textfile: {args.prom} "
+              f"({len(dump['series'])} series)")
+    if args.stitch is not None:
+        out = args.stitch or None
+        path, merged = stitch(args.root, out)
+        other = merged.get("otherData", {})
+        if path is None:
+            print(f"stitch: no {TRACE_FILENAME} under {args.root} — "
+                  "run hosts with trace=true", file=sys.stderr)
+            return 1
+        print(f"stitched fleet trace: {path} "
+              f"({len(merged['traceEvents'])} events, "
+              f"{len(other.get('hosts', []))} host lane(s), "
+              + ("wall-clock aligned" if other.get("aligned")
+                 else "UNALIGNED — unanchored traces present")
+              + ") — open in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

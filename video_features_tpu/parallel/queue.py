@@ -248,6 +248,13 @@ class WorkQueue:
                 rec.update(host_id=self.host_id, run_id=self.run_id,
                            claim_time=round(now, 3),
                            deadline=round(deadline, 3))
+                # request correlation (telemetry/context.py): a lease
+                # claimed on behalf of a spool request carries its id, so
+                # the claim/steal/quarantine trail of a request's videos
+                # is retrievable too; absent outside serve mode
+                rid = telemetry.current_request_id()
+                if rid is not None:
+                    rec["request_id"] = rid
                 write_json_atomic(dst, rec)
             with self._lock:
                 self._active[iid] = rec

@@ -64,13 +64,31 @@ throttled while the shared-decode fan-out gauges
 backpressure, so admission follows the pipeline's own signals rather
 than a guess.
 
+**Request-scoped correlation** (telemetry/context.py): each claimed
+request's videos run under ``use_request(id)``, so every span record,
+health digest, failure-journal entry and ``video_attempt`` trace span
+they produce carries the request id — one id retrieves everything a
+request touched, on any host (``vft-fleet --request <id>``).
+
+**SLOs**: queue-wait (submit -> claim) and service (claim -> response)
+land in the fixed-bucket latency histograms
+(``vft_serve_queue_wait_seconds`` / ``vft_serve_service_seconds``,
+telemetry/metrics.py), and with ``serve_slo_s=`` set, a request whose
+wait+service exceeds it bumps ``vft_serve_slo_violations_total``. The
+heartbeat ``serve`` section publishes p50/p95/p99 of both splits plus
+attainment %, so SLO state is readable live off the spool (and
+fleet-wide via ``vft-fleet``) — no unbounded in-memory latency list, no
+scrape endpoint. ``trace=true`` additionally runs the Chrome-trace
+recorder homed on the spool, so ``serve.request`` windows land on the
+timeline ``vft-fleet --stitch`` merges across hosts.
+
 Run it: ``vft-serve feature_type=resnet spool_dir=/srv/vft ...`` (or
 ``python main.py serve ...``). All family config keys apply; the
 serve-specific keys are ``spool_dir`` (required), ``serve_workers``,
-``serve_max_pending``, ``serve_poll_interval_s``, ``serve_idle_exit_s``
-and ``serve_max_requests`` (the latter two bound a session — tests,
-benches, canaries). SIGTERM finishes in-flight work, writes a final
-heartbeat and exits 143 (the CLI's preemption contract).
+``serve_max_pending``, ``serve_poll_interval_s``, ``serve_slo_s``,
+``serve_idle_exit_s`` and ``serve_max_requests`` (the latter two bound
+a session — tests, benches, canaries). SIGTERM finishes in-flight work,
+writes a final heartbeat and exits 143 (the CLI's preemption contract).
 """
 from __future__ import annotations
 
@@ -221,7 +239,18 @@ class ServeLoop:
         self._tallies = {"done": 0, "partial": 0, "failed": 0,
                          "rejected": 0}
         self._inflight = 0
-        self._request_latencies: List[float] = []
+        self._inflight_rids: set = set()
+        # SLO accounting: the latency *distributions* live in the
+        # recorder registry's fixed-bucket histograms (bounded by
+        # construction); this deque only keeps a small recent window for
+        # the heartbeat's last/mean lines. The unbounded per-request
+        # list this replaces grew for the life of the server.
+        import collections
+        self._recent = collections.deque(maxlen=32)
+        slo = args.get("serve_slo_s")
+        self.slo_s = float(slo) if slo is not None else None
+        self._answered = 0
+        self._slo_violations = 0
 
         # -- warm construction: params resident for the process lifetime --
         if per_family is not None:
@@ -278,14 +307,31 @@ class ServeLoop:
             host_id=host_id)
         self.recorder.extra_sections["serve"] = self._serve_section
 
+        # pipeline tracing (trace=true): the Chrome-trace recorder homed
+        # on the SPOOL dir like the heartbeat, so `serve.request` /
+        # `video_attempt` windows (each stamped with its request id) land
+        # on the timeline vft-fleet --stitch merges across hosts. Same
+        # lifecycle as the batch CLI's: armed here, drained at exit.
+        self.tracer = None
+        if bool(args.get("trace", False)):
+            from .telemetry.trace import TraceRecorder
+            # per-host filename: sibling servers share one spool, and
+            # each must leave its own stitchable timeline behind
+            self.tracer = TraceRecorder(self.spool_dir,
+                                        host_id=host_id).start()
+
     # -- heartbeat serve section ------------------------------------------
     def _serve_section(self) -> dict:
+        from .telemetry.metrics import LATENCY_BUCKETS, histogram_quantiles
         with self._state_lock:
-            lat = list(self._request_latencies[-32:])
+            lat = list(self._recent)
+            answered = self._answered
+            violations = self._slo_violations
             section = {
                 "state": self._state,
                 "pending": self._pending_count(),
                 "inflight": self._inflight,
+                "active_requests": sorted(self._inflight_rids),
                 "workers": self.workers,
                 "max_pending": self.max_pending,
                 "requests": dict(self._tallies),
@@ -293,7 +339,46 @@ class ServeLoop:
         if lat:
             section["last_latency_s"] = round(lat[-1], 3)
             section["mean_latency_s"] = round(sum(lat) / len(lat), 3)
+        # SLO block: percentiles straight off the registry histograms —
+        # a pure function of bounded state, so a scraper (or vft-fleet)
+        # reads p50/p95/p99 + attainment from the heartbeat file alone
+        reg = self.recorder.registry
+        section["slo"] = {
+            "slo_s": self.slo_s,
+            "requests": answered,
+            "violations": violations,
+            "attainment_pct": (round(100.0 * (answered - violations)
+                                     / answered, 2) if answered else None),
+            "queue_wait": histogram_quantiles(reg.histogram(
+                "vft_serve_queue_wait_seconds",
+                buckets=LATENCY_BUCKETS).snapshot()),
+            "service": histogram_quantiles(reg.histogram(
+                "vft_serve_service_seconds",
+                buckets=LATENCY_BUCKETS).snapshot()),
+        }
         return section
+
+    def _account_request(self, wait_s: float, service_s: float) -> bool:
+        """Fold one answered request into the SLO state: both splits into
+        their histograms, the recent window, and — when ``serve_slo_s``
+        is set — the violation counter when wait+service exceeds it.
+        Returns True when this request violated the SLO."""
+        from .telemetry.metrics import LATENCY_BUCKETS
+        reg = self.recorder.registry
+        reg.histogram("vft_serve_queue_wait_seconds",
+                      buckets=LATENCY_BUCKETS).observe(wait_s)
+        reg.histogram("vft_serve_service_seconds",
+                      buckets=LATENCY_BUCKETS).observe(service_s)
+        violated = (self.slo_s is not None
+                    and wait_s + service_s > self.slo_s)
+        with self._state_lock:
+            self._recent.append(service_s)
+            self._answered += 1
+            if violated:
+                self._slo_violations += 1
+        if violated:
+            reg.counter("vft_serve_slo_violations_total").inc()
+        return violated
 
     def _pending_count(self) -> int:
         try:
@@ -351,26 +436,40 @@ class ServeLoop:
             return
         wait_s = max(0.0, time.time() - float(req.get("time") or time.time()))
         statuses: Dict[str, Dict[str, str]] = {}
-        with trace.span("serve.request", id=rid, videos=len(videos)):
-            # videos of ONE request run on this request's worker thread
-            # sequentially; concurrency comes from multiple claimed
-            # requests in flight, which is exactly what packs their clips
-            # into shared device groups (parallel/packer.py)
-            for v in videos:
-                if self._stop.is_set():
-                    statuses[v] = {f: "dropped" for f in self.families}
-                    continue
-                try:
-                    statuses[v] = self._run_one_video(v)
-                except Exception as e:  # safe_extract contains per-video
-                    # failures; this guards the serve loop itself
-                    statuses[v] = {f: "error" for f in self.families}
-                    print(f"serve: request {rid} video {v} escaped: "
-                          f"{type(e).__name__}: {e}", file=sys.stderr)
+        from .telemetry.context import use_request
+        with self._state_lock:
+            self._inflight_rids.add(rid)
+        try:
+            # request-scoped correlation: every span/health/journal/trace
+            # record the videos below produce carries this request's id
+            # (telemetry/context.py) — thread-local, so concurrent
+            # requests on sibling workers never cross-stamp
+            with use_request(rid), \
+                    trace.span("serve.request", id=rid, videos=len(videos)):
+                # videos of ONE request run on this request's worker
+                # thread sequentially; concurrency comes from multiple
+                # claimed requests in flight, which is exactly what packs
+                # their clips into shared device groups
+                # (parallel/packer.py)
+                for v in videos:
+                    if self._stop.is_set():
+                        statuses[v] = {f: "dropped" for f in self.families}
+                        continue
+                    try:
+                        statuses[v] = self._run_one_video(v)
+                    except Exception as e:  # safe_extract contains
+                        # per-video failures; this guards the serve loop
+                        statuses[v] = {f: "error" for f in self.families}
+                        print(f"serve: request {rid} video {v} escaped: "
+                              f"{type(e).__name__}: {e}", file=sys.stderr)
+        finally:
+            with self._state_lock:
+                self._inflight_rids.discard(rid)
         flat = [s for per in statuses.values() for s in per.values()]
         ok = all(s in ("done", "skipped") for s in flat) and flat
         latency = time.perf_counter() - t0
-        self._respond(rid, {
+        violated = self._account_request(wait_s, latency)
+        payload = {
             "status": "done" if ok else "partial",
             "videos": statuses,
             "output_path": self.out_root,
@@ -379,10 +478,12 @@ class ServeLoop:
             # flat after request 1 == no recompilation (the acceptance
             # signal; misses here mean a new (family, shape) executable)
             "compile_cache": compile_cache_summary(mon_before),
-        })
+        }
+        if self.slo_s is not None:
+            payload["slo_violated"] = bool(violated)
+        self._respond(rid, payload)
         with self._state_lock:
             self._tallies["done" if ok else "partial"] += 1
-            self._request_latencies.append(latency)
         try:
             os.unlink(claimed_path)
         except OSError:
@@ -592,6 +693,10 @@ class ServeLoop:
                 self._inflight = 0
                 self._state = "exited"
             self.recorder.close(tally=None, wall_s=None)
+            if self.tracer is not None:
+                # atomic temp+rename at close — an aborted server still
+                # leaves a complete, stitchable trace behind
+                self.tracer.close()
         return 143 if self._stop.is_set() else 0
 
     def stop(self) -> None:

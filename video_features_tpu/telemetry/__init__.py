@@ -35,6 +35,7 @@ from typing import Any, Optional
 
 from .spans import (NOOP_SPAN, NoopSpan, SPAN_FIELDS, STATUSES,  # noqa: F401
                     VideoSpan, current_span, use_span)
+from .context import current_request_id, use_request  # noqa: F401
 from .metrics import MetricsRegistry, prometheus_text  # noqa: F401
 
 #: the active run's TelemetryRecorder, or None (telemetry disabled)

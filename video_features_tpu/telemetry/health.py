@@ -53,8 +53,9 @@ HEALTH_SCHEMA_PATH = os.path.join(os.path.dirname(__file__),
 #: scripts/check_health_schema.py asserts these equal the JSON Schema's
 #: properties, the same emitter<->contract pinning as spans.SPAN_FIELDS
 HEALTH_FIELDS = (
-    "schema", "video", "feature_type", "key", "shape", "dtype", "elems",
-    "nan", "inf", "min", "max", "mean", "std", "l2", "sig", "time",
+    "schema", "video", "feature_type", "request_id", "key", "shape",
+    "dtype", "elems", "nan", "inf", "min", "max", "mean", "std", "l2",
+    "sig", "time",
 )
 
 #: content-signature quantization grid: values are snapped to multiples
@@ -116,10 +117,14 @@ def digest_array(key: str, value: Any, *, video: str,
             "std": float(finite.std()),
             "l2": float(np.sqrt(np.square(finite).sum())),
         }
+    from .context import current_request_id
     return {
         "schema": SCHEMA_VERSION,
         "video": str(video),
         "feature_type": feature_type,
+        # serve-mode correlation (telemetry/context.py): the id of the
+        # spool request this digest belongs to; null in batch runs
+        "request_id": current_request_id(),
         "key": str(key),
         "shape": [int(s) for s in a.shape],
         "dtype": str(a.dtype),

@@ -189,6 +189,49 @@ class MetricsRegistry:
         return {"series": out}
 
 
+def histogram_quantile(snapshot: dict, q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0..1) of a :meth:`Histogram.snapshot`
+    dump, Prometheus ``histogram_quantile`` style: walk the fixed buckets
+    until the cumulative count crosses the rank, then interpolate
+    linearly inside that bucket. A pure function of the JSON-safe
+    snapshot, so serve heartbeats, the run manifest and the fleet
+    aggregator all compute percentiles from artifacts alone.
+
+    Returns None for an empty histogram. Observations past the last
+    finite bucket (the implicit ``+Inf`` bucket) clamp to the largest
+    finite bound — with the default :data:`LATENCY_BUCKETS` that is
+    300 s, far beyond any sane serve SLO, so the clamp never hides a
+    violation."""
+    total = int(snapshot.get("count", 0))
+    buckets = snapshot.get("buckets") or []
+    if total <= 0 or not buckets:
+        return None
+    rank = max(0.0, min(1.0, float(q))) * total
+    cum = 0.0
+    prev_le = 0.0
+    for b in buckets:
+        c = float(b.get("count", 0))
+        le = float(b.get("le", 0.0))
+        if c > 0 and cum + c >= rank:
+            frac = (rank - cum) / c
+            return prev_le + (le - prev_le) * frac
+        cum += c
+        prev_le = le
+    return float(buckets[-1]["le"])
+
+
+def histogram_quantiles(snapshot: dict,
+                        qs: Sequence[float] = (0.5, 0.95, 0.99)
+                        ) -> Dict[str, Optional[float]]:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` off one snapshot — the
+    shape the heartbeat ``serve`` section and report tools render."""
+    out: Dict[str, Optional[float]] = {}
+    for q in qs:
+        v = histogram_quantile(snapshot, q)
+        out[f"p{q * 100:g}"] = None if v is None else round(v, 4)
+    return out
+
+
 def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
     items = dict(labels)
     if extra:

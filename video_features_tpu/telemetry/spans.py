@@ -48,7 +48,7 @@ MAX_SPAN_EVENTS = 256
 #: Schema's properties, and tests validate emitted records against both
 SPAN_FIELDS = (
     "schema", "video", "status", "feature_type", "host", "host_id", "pid",
-    "start_time", "wall_s", "attempts", "category", "error",
+    "request_id", "start_time", "wall_s", "attempts", "category", "error",
     "decode_mode", "decode_shared_ms", "ladder_steps", "stages",
     "video_fps", "video_frames", "events",
 )
@@ -85,10 +85,15 @@ class VideoSpan:
     def __init__(self, video: str, recorder=None,
                  feature_type: Optional[str] = None,
                  host_id: Optional[str] = None) -> None:
+        from .context import current_request_id
         self.video = str(video)
         self.recorder = recorder
         self.feature_type = feature_type
         self.host_id = host_id
+        # request-scoped correlation (telemetry/context.py): spans are
+        # minted on the serve worker thread that owns the request, so the
+        # id is captured here once; None outside serve mode
+        self.request_id = current_request_id()
         self.record: Optional[dict] = None  # set at __exit__
         self._lock = threading.Lock()
         self._attrs: Dict[str, Any] = {}
@@ -172,6 +177,7 @@ class VideoSpan:
             "host": socket.gethostname(),
             "host_id": self.host_id,
             "pid": os.getpid(),
+            "request_id": self.request_id,
             "start_time": round(self._start_time, 3),
             "wall_s": round(wall, 6),
             "attempts": int(attrs.get("attempts", 1)),

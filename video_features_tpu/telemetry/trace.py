@@ -55,6 +55,24 @@ from . import jsonl
 
 TRACE_FILENAME = "_trace.json"
 
+#: stitched/merged outputs share the ``_trace`` prefix but are never
+#: inputs: trace discovery (trace_report, vft-fleet --stitch) skips them
+TRACE_OUTPUT_NAMES = ("_trace_fleet.json", "_trace_merged.json")
+
+
+def trace_filename(host_id: Optional[str] = None) -> str:
+    """The trace artifact name: ``_trace.json`` for a single-writer
+    output dir, ``_trace_{host_id}.json`` when N hosts co-own one dir
+    (fleet=queue workers, vft-serve siblings on a spool) — otherwise the
+    last host to close would silently overwrite every other host's
+    timeline, and ``vft-fleet --stitch`` could never show the fleet.
+    Sanitation matches telemetry/heartbeat.py heartbeat_filename."""
+    if host_id is None:
+        return TRACE_FILENAME
+    import re
+    safe = re.sub(r"[^A-Za-z0-9._-]+", "-", str(host_id))
+    return f"_trace_{safe}.json"
+
 #: trace format identifier stamped into ``otherData``
 TRACE_SCHEMA = "vft.trace/1"
 
@@ -215,9 +233,12 @@ class TraceRecorder:
 
     def __init__(self, output_path: str, *,
                  pid: Optional[int] = None,
+                 host_id: Optional[str] = None,
                  max_events_per_thread: int = MAX_EVENTS_PER_THREAD) -> None:
         self.output_path = str(output_path)
-        self.trace_path = os.path.join(self.output_path, TRACE_FILENAME)
+        self.host_id = host_id
+        self.trace_path = os.path.join(self.output_path,
+                                       trace_filename(host_id))
         self.pid = os.getpid() if pid is None else int(pid)
         self.max_events_per_thread = int(max_events_per_thread)
         self._t0 = time.perf_counter()
@@ -333,7 +354,11 @@ class TraceRecorder:
             "otherData": {
                 "schema": TRACE_SCHEMA,
                 "host": socket.gethostname(),
+                "host_id": self.host_id,
                 "pid": self.pid,
+                # the wall-clock anchor: event time = start_unix + ts/1e6.
+                # trace_report --merge and vft-fleet --stitch align
+                # timelines from different hosts/runs on it
                 "start_unix": round(self._start_unix, 3),
                 "wall_s": round(time.perf_counter() - self._t0, 3),
                 "events": len(events),

@@ -334,6 +334,13 @@ class FailureJournal:
             "host": socket.gethostname(),
             "time": time.time(),
         }
+        # serve-mode correlation (telemetry/context.py): stamp the spool
+        # request whose video failed; absent in batch runs, so existing
+        # journal records and their consumers are untouched
+        from ..telemetry.context import current_request_id
+        rid = current_request_id()
+        if rid is not None:
+            rec["request_id"] = rid
         self._append(rec)
         from .. import telemetry
         telemetry.inc("vft_failures_total", category=str(category))

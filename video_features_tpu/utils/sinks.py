@@ -287,9 +287,13 @@ def safe_extract(extract_fn, video_path: str, policy=None, journal=None,
         try:
             # one timeline span per attempt (trace=true; no-op otherwise):
             # the unit trace_report.py cuts the per-video critical path on,
-            # recorded for failed attempts too
+            # recorded for failed attempts too. In serve mode the attempt
+            # additionally names its spool request (telemetry/context.py),
+            # so one request id finds its timeline windows across hosts.
+            _rid = telemetry.current_request_id()
             with trace.span("video_attempt", video=str(video_path),
-                            attempt=attempt):
+                            attempt=attempt,
+                            **({"request": _rid} if _rid else {})):
                 with ctx:
                     result = extract_fn(video_path)
             if attempt > 1:

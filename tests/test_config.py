@@ -253,3 +253,22 @@ def test_serve_slo_key_validation(tmp_path):
         with pytest.raises(ValueError, match="serve_slo_s"):
             sanity_check(load_config("resnet",
                                      {**base, "serve_slo_s": bad}))
+
+
+def test_compile_cache_key_validation(tmp_path):
+    """compile_cache= / compile_cache_dir= (compile_cache.py, ISSUE 11):
+    'auto'/true/false pass, anything else fails at launch — a typo'd
+    switch must not silently compile cold forever."""
+    base = dict(video_paths="a.mp4", output_path=str(tmp_path / "o"),
+                tmp_path=str(tmp_path / "t"))
+    sanity_check(load_config("resnet", {**base, "compile_cache": True}))
+    sanity_check(load_config("resnet", {**base, "compile_cache": False}))
+    sanity_check(load_config("resnet", {
+        **base, "compile_cache": "auto",
+        "compile_cache_dir": str(tmp_path / "cc")}))
+    with pytest.raises(ValueError, match="compile_cache="):
+        sanity_check(load_config("resnet",
+                                 {**base, "compile_cache": "always"}))
+    with pytest.raises(ValueError, match="compile_cache_dir"):
+        sanity_check(load_config("resnet",
+                                 {**base, "compile_cache_dir": 7}))

@@ -408,3 +408,69 @@ def test_cli_canary_join_passes_end_to_end(sample_video, tmp_path, capsys):
     assert len(verdicts) == 1 and verdicts[0]["ok"] is True, verdicts
     assert len(verdicts[0]["videos"]) == 2
     assert len(list((qdir / "done").glob("*.json"))) == 2
+
+
+def test_canary_warm_tightens_timing_band_and_heartbeat(tmp_path):
+    """ISSUE 11 warm fast path: a joining host whose compile-cache
+    fingerprint fully hit has no cold compile for the generous timing
+    band to absorb — the re-compile allowance is skipped (band tightens
+    to WARM_CANARY_BAND) and canary_warm lands in the heartbeat fleet
+    section + the verdict file."""
+    from video_features_tpu.telemetry.jsonl import append_jsonl
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk)
+    v = tmp_path / "v0.mp4"
+    v.write_bytes(b"x")
+    a.seed([str(v)])
+    a.complete(a.claim_next(), "done", elapsed_s=2.0)
+    append_jsonl(tmp_path / "_health.jsonl", _health_rec(str(v)))
+
+    def extract(video, out_dir):
+        append_jsonl(Path(out_dir) / "_health.jsonl", _health_rec(video))
+        return "done", 3.0  # 1.5x the fleet median
+
+    # a COLD joiner passes: 1.5x sits inside the default 2x compile
+    # allowance
+    cold = _wq(tmp_path, "B", clk)
+    ok, lines = cold.canary_gate(extract)
+    assert ok, lines
+    # default heartbeat section: not warm, idle counter present
+    sect = cold.heartbeat_section()
+    assert sect["canary_warm"] is False
+    assert sect["idle_wait_s_total"] == 0.0
+
+    # the SAME timing, warm: no compile to absorb, band tightens, FAIL
+    warm = _wq(tmp_path, "C", clk)
+    warm.canary_warm = True
+    ok, lines = warm.canary_gate(extract)
+    assert not ok
+    assert any("compile cache warm" in l and "tightened" in l
+               for l in lines), lines
+    assert warm.heartbeat_section()["canary_warm"] is True
+    verdict = json.loads(
+        (tmp_path / "_queue" / "canary" / "C.json").read_text())
+    assert verdict["canary_warm"] is True and verdict["ok"] is False
+
+
+def test_drain_accumulates_idle_wait(tmp_path):
+    """The capacity planner's stall-share signal: a host idling behind
+    another host's live lease accumulates idle_wait_s_total in its
+    heartbeat fleet section."""
+    clk = Clock()
+    _hb(tmp_path, "A", now=clk.t)
+    _hb(tmp_path, "B", now=clk.t)
+    a = _wq(tmp_path, "A", clk)
+    b = _wq(tmp_path, "B", clk)
+    a.seed(["only.mp4"])
+    rec = a.claim_next()  # A holds the only item, unexpired
+    assert rec is not None
+    stop = threading.Event()
+
+    def finish():
+        time.sleep(0.12)
+        a.complete(rec, "done")
+    t = threading.Thread(target=finish)
+    t.start()
+    b.drain(lambda v: "done", workers=1, stop=stop, poll_s=0.02)
+    t.join()
+    assert b.heartbeat_section()["idle_wait_s_total"] > 0.0

@@ -251,3 +251,127 @@ def test_find_request_across_artifacts(tmp_path):
     # and the CLI form renders them
     assert fleet_report.main([str(root), "--request", rid]) == 0
     assert fleet_report.main([str(root), "--request", "missing"]) == 1
+
+
+# -- ISSUE 11: compile-cache aggregation + capacity decision plane -----------
+
+def test_compile_cache_aggregation_and_render(tmp_path):
+    root = tmp_path / "out"
+    hb1 = _hb("warm-1", NOW - 2)
+    hb1["compile_cache"] = {"hits": 4, "misses": 0, "entry": "abc123def456",
+                            "family": "resnet", "warm_at_attach": True,
+                            "verified": 4, "dropped": 0}
+    hb2 = _hb("cold-1", NOW - 2)
+    hb2["compile_cache"] = {"hits": 0, "misses": 3, "entry": "abc123def456",
+                            "family": "resnet", "warm_at_attach": False,
+                            "verified": 0, "dropped": 1}
+    _write_hb(root, hb1)
+    _write_hb(root, hb2)
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    cc = agg["compile_cache"]
+    assert cc["hits"] == 4 and cc["misses"] == 3
+    assert cc["warm_hosts"] == 1 and cc["attached_hosts"] == 2
+    assert cc["dropped"] == 1
+    assert cc["hit_rate"] == round(4 / 7, 4)
+    assert cc["entries"] == ["abc123def456"]
+    text = "\n".join(fleet_report.render(agg))
+    assert "== compile cache ==" in text and "warm_hosts=1/2" in text
+    dump = fleet_report.build_prom_dump(agg)
+    names = {s["name"] for s in dump["series"]}
+    assert "vft_fleet_compile_cache_hits_total" in names
+    assert "vft_fleet_compile_cache_warm_hosts" in names
+
+
+def _agg(live=2, pending=0, claimed=0, idle_s=0.0, uptime_s=100.0,
+         fleet_hosts=2, attainment=None, requests=0):
+    return {
+        "n_hosts": {"live": live, "stalled": 0, "finished": 0,
+                    "prior_run": 0, "unreadable": 0},
+        "queue": {"pending": pending, "claimed": claimed, "done": 0,
+                  "quarantined": 0},
+        "capacity_inputs": {"idle_wait_s_total": idle_s,
+                            "uptime_s": uptime_s,
+                            "fleet_hosts": fleet_hosts},
+        "serve": {"hosts": [], "totals": {
+            "requests": requests, "violations": 0,
+            "attainment_pct": attainment}},
+        "hosts": [],
+    }
+
+
+def test_planner_scale_up_on_queue_depth_needs_confirmation():
+    """Hysteresis: a single hot observation is pressure, not a
+    recommendation; the second consecutive one flips it."""
+    p = fleet_report.CapacityPlanner(confirm_ticks=2, cooldown_s=0.0)
+    r1 = p.observe(_agg(live=2, pending=10), now=NOW)
+    assert r1["pressure"] == "scale_up"
+    assert r1["recommendation"] == "hold"
+    assert any("confirmation" in x for x in r1["reasons"])
+    r2 = p.observe(_agg(live=2, pending=10), now=NOW + 2)
+    assert r2["recommendation"] == "scale_up" and r2["changed"]
+    assert any("queue depth" in x for x in r2["reasons"])
+
+
+def test_planner_cooldown_pins_recommendation():
+    p = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=300.0)
+    r1 = p.observe(_agg(live=2, pending=10), now=NOW)
+    assert r1["recommendation"] == "scale_up"
+    # queue drains and the fleet idles — but the cooldown pins the
+    # verdict (the scale-up may still be landing)
+    drained = _agg(live=2, pending=0, idle_s=90.0, uptime_s=100.0)
+    r2 = p.observe(drained, now=NOW + 10)
+    assert r2["pressure"] == "scale_down"
+    assert r2["recommendation"] == "scale_up"
+    assert any("cooldown" in x for x in r2["reasons"])
+    # past the cooldown the same pressure flips it
+    r3 = p.observe(_agg(live=2, pending=0, idle_s=95.0, uptime_s=101.0),
+                   now=NOW + 400)
+    assert r3["recommendation"] == "scale_down"
+
+
+def test_planner_scale_down_needs_drained_idle_fleet():
+    p = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=0.0)
+    # idle share high but work still pending: NOT a scale-down
+    r = p.observe(_agg(live=2, pending=3, idle_s=90.0), now=NOW)
+    assert r["recommendation"] != "scale_down"
+    p2 = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=0.0)
+    r = p2.observe(_agg(live=2, pending=0, claimed=0, idle_s=90.0,
+                        uptime_s=100.0), now=NOW)
+    assert r["recommendation"] == "scale_down"
+    assert r["idle_share"] == 0.9
+    # a single host never scales itself away
+    p3 = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=0.0)
+    r = p3.observe(_agg(live=1, fleet_hosts=1, pending=0, idle_s=90.0),
+                   now=NOW)
+    assert r["recommendation"] == "hold"
+
+
+def test_planner_slo_attainment_slope():
+    """Attainment below target and not recovering is a scale-up; the
+    slope is measured across the observation window."""
+    p = fleet_report.CapacityPlanner(confirm_ticks=2, cooldown_s=0.0,
+                                     slo_target_pct=95.0)
+    r1 = p.observe(_agg(live=2, attainment=92.0, requests=100), now=NOW)
+    assert r1["pressure"] == "scale_up"
+    r2 = p.observe(_agg(live=2, attainment=90.0, requests=120),
+                   now=NOW + 60)
+    assert r2["attainment_slope_pct_per_min"] == -2.0
+    assert r2["recommendation"] == "scale_up"
+    # recovering attainment (positive slope) is NOT a scale-up even
+    # while still below target — the last action is working
+    p2 = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=0.0)
+    p2.observe(_agg(live=2, attainment=90.0, requests=100), now=NOW)
+    r = p2.observe(_agg(live=2, attainment=93.0, requests=120),
+                   now=NOW + 60)
+    assert r["pressure"] == "hold"
+
+
+def test_planner_idle_share_uses_window_delta():
+    p = fleet_report.CapacityPlanner(confirm_ticks=1, cooldown_s=0.0)
+    p.observe(_agg(live=2, pending=0, idle_s=10.0, uptime_s=100.0),
+              now=NOW)
+    # over the next window the fleet was idle 45 of 50 host-seconds
+    r = p.observe(_agg(live=2, pending=0, idle_s=55.0, uptime_s=150.0),
+                  now=NOW + 25)
+    assert r["idle_share"] == 0.9
+    assert r["recommendation"] == "scale_down"

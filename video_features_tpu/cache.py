@@ -83,8 +83,10 @@ NON_SEMANTIC_KEYS = frozenset({
     # fleet scheduling (parallel/queue.py) moves work between hosts; it
     # cannot change what any (video, config, weights) triple computes
     "fleet", "fleet_lease_s", "fleet_max_reclaims", "fleet_canary",
-    # the cache's own knobs must not key the cache
-    "cache", "cache_dir",
+    # the cache's own knobs must not key the cache; the compile cache's
+    # knobs (compile_cache.py) likewise change where executables come
+    # from, never what any program computes
+    "cache", "cache_dir", "compile_cache", "compile_cache_dir",
     # chaos-injection plans perturb scheduling/IO, never feature values
     # (a fault either recovers bit-identically or fails the video)
     "inject",

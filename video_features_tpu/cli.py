@@ -106,6 +106,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         # (serve.py; also installed as the `vft-serve` console script)
         from .serve import serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "warmup":
+        # ahead-of-time compile warmup: `python main.py warmup resnet ...`
+        # routes to the store populator (compile_cache.py; also installed
+        # as the `vft-warmup` console script)
+        from .compile_cache import warmup_main
+        return warmup_main(argv[1:])
     cli_args = parse_dotlist(argv)
     if "feature_type" not in cli_args:
         raise SystemExit("Usage: main.py feature_type=<family>[,<family>...]"
@@ -150,6 +156,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"inject: armed plan {inject_plan.spec!r} "
               f"(seed={inject_plan.seed}; docs/chaos.md — replay by "
               "re-running with this exact inject= string)")
+
+    # Fleet-shared compile cache (compile_cache.py): attach this process
+    # to its (family, resolved config, environment) entry BEFORE the
+    # extractors are even constructed — the init-time compiles (flax
+    # model.init of the scan-heavy families costs seconds) are part of
+    # the warm set. Verify-before-trust on the way in, sealed in the
+    # finally below. Supersedes the per-machine compilation_cache_dir
+    # wiring above whenever it resolves enabled. A warm attach means a
+    # joining host compiles nothing it has seen before.
+    from . import compile_cache
+    cc_entry = (compile_cache.attach_for_multi_args(per_family) if multi_mode
+                else compile_cache.attach_for_args(args.feature_type, args))
+    if cc_entry is not None:
+        print(f"compile cache: entry {cc_entry.key[:12]} "
+              f"({'warm' if cc_entry.warm_at_attach else 'cold'}, "
+              f"{cc_entry.verified} verified"
+              + (f", {cc_entry.dropped} dropped" if cc_entry.dropped else "")
+              + f") at {cc_entry.dir}")
 
     if multi_mode:
         from .extractors.multi import MultiExtractor
@@ -305,6 +329,12 @@ def main(argv: Optional[List[str]] = None) -> None:
             max_reclaims=int(args.get("fleet_max_reclaims") or 3),
             journal=(journal if not multi_mode else None))
         recorder.extra_sections["fleet"] = work_queue.heartbeat_section
+        # canary warm fast path (compile_cache.py): a joining host whose
+        # compile-cache fingerprint fully hit has no cold-compile jitter
+        # for the canary timing bands to absorb — the gate tightens, and
+        # the heartbeat fleet section records canary_warm=true
+        work_queue.canary_warm = bool(cc_entry is not None
+                                      and cc_entry.warm_at_attach)
         seeded = work_queue.seed(video_paths)
         print(f"fleet: queue mode — seeded {seeded} new item(s) into "
               f"{work_queue.root} as {host_id}")
@@ -462,6 +492,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             # counters land in the manifest metrics dump too)
             print(inject_plan.summary())
         inject.disarm()  # in-process callers must not inherit the plan
+        # seal the compile-cache entry even on an aborted run: every
+        # executable XLA finished writing is complete (its own write is
+        # atomic), and sealing it saves the next host that compile
+        compile_cache.seal_active()
 
     elapsed = time.perf_counter() - t_run
     n_run = sum(tally.values())

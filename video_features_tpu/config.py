@@ -346,6 +346,21 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
                          "null (null -> VFT_CACHE_DIR or "
                          "~/.cache/video_features_tpu/feature_cache)")
 
+    # compile-cache keys (compile_cache.py): the fleet-shared persistent
+    # XLA store — a typo'd switch must not silently compile cold forever
+    cc = args.get("compile_cache", "auto")
+    if cc not in (True, False, "auto"):
+        raise ValueError(f"compile_cache={cc!r}: expected true, false or "
+                         "'auto' ('auto' = on for TPU runs; CPU runs need "
+                         "an explicit compile_cache_dir — "
+                         "docs/performance.md 'Never compile twice, fleet "
+                         "edition')")
+    ccd = args.get("compile_cache_dir")
+    if ccd is not None and not isinstance(ccd, str):
+        raise ValueError(f"compile_cache_dir={ccd!r}: expected a directory "
+                         "path or null (null -> VFT_COMPILE_CACHE_DIR or "
+                         "~/.cache/video_features_tpu/compile_cache)")
+
     # fleet scheduling keys (parallel/queue.py): validated at launch —
     # a typo'd fleet mode must fail before N hosts start claiming
     fl = args.get("fleet", "static") or "static"

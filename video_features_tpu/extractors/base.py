@@ -54,6 +54,12 @@ class BaseExtractor:
             self._weights_capture = _wstore.start_weights_capture()
         self._cache = None
         self._cache_built = False
+        # compile_cache= (compile_cache.py): the fleet-shared persistent
+        # XLA store. The CLI/serve drivers attach explicitly right after
+        # construction; this lazy flag covers library callers who invoke
+        # _extract directly (attach is process-global first-wins, so the
+        # double path cannot double-attach).
+        self._compile_cache_checked = False
         # video_decode=process: each video's decode+transform runs in a
         # spawned worker process (utils/io.py ProcessVideoSource) — lifts
         # the parent-GIL ceiling on numpy/PIL transform work on multi-core
@@ -237,6 +243,12 @@ class BaseExtractor:
 
     def _extract(self, video_path: str) -> Optional[Dict[str, np.ndarray]]:
         from .. import telemetry
+        if not self._compile_cache_checked:
+            # before the first compile, after every resolved attribute
+            # exists — the same lazy point the feature cache uses
+            self._compile_cache_checked = True
+            from ..compile_cache import attach_for_extractor
+            attach_for_extractor(self)
         # Precedence: cache hit > filename skip (docs/performance.md).
         # The cache key proves the CONTENT + config + weights match; the
         # filename skip only proves a file with the right name loads —

@@ -238,10 +238,31 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
 
     cache = {"hits": 0, "misses": 0, "bypasses": 0}
     by_family_cache: Dict[str, Dict[str, int]] = {}
+    compile_cache = {"hits": 0, "misses": 0, "warm_hosts": 0,
+                     "attached_hosts": 0, "dropped": 0}
+    cc_entries: set = set()
     slo_hosts: List[dict] = []
     slo_totals = {"requests": 0, "violations": 0}
+    idle_inputs = {"idle_wait_s_total": 0.0, "uptime_s": 0.0,
+                   "fleet_hosts": 0}
     for e in current:
         hb = e["hb"]
+        cc = hb.get("compile_cache")
+        if isinstance(cc, dict):
+            compile_cache["hits"] += int(cc.get("hits") or 0)
+            compile_cache["misses"] += int(cc.get("misses") or 0)
+            compile_cache["dropped"] += int(cc.get("dropped") or 0)
+            if cc.get("entry"):
+                compile_cache["attached_hosts"] += 1
+                cc_entries.add(str(cc["entry"]))
+            if cc.get("warm_at_attach"):
+                compile_cache["warm_hosts"] += 1
+        fl = hb.get("fleet")
+        if isinstance(fl, dict) and e["state"] == "live":
+            idle_inputs["idle_wait_s_total"] += \
+                float(fl.get("idle_wait_s_total") or 0.0)
+            idle_inputs["uptime_s"] += float(hb.get("uptime_s") or 0.0)
+            idle_inputs["fleet_hosts"] += 1
         ca = hb.get("cache") or {}
         for k in ("hits", "misses", "bypasses"):
             per = ca.get(k) or {}
@@ -264,6 +285,11 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
     consulted = cache["hits"] + cache["misses"]
     cache["hit_rate"] = (round(cache["hits"] / consulted, 4)
                          if consulted else None)
+    cc_consulted = compile_cache["hits"] + compile_cache["misses"]
+    compile_cache["hit_rate"] = (
+        round(compile_cache["hits"] / cc_consulted, 4)
+        if cc_consulted else None)
+    compile_cache["entries"] = sorted(cc_entries)
     n_req = slo_totals["requests"]
     slo_totals["attainment_pct"] = (
         round(100.0 * (n_req - slo_totals["violations"]) / n_req, 2)
@@ -286,14 +312,198 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         "queue": _queue_counts(root, entries),
         "cache": cache,
         "cache_by_family": by_family_cache,
+        "compile_cache": compile_cache,
+        "capacity_inputs": idle_inputs,
         "families": collect_family_throughput(root),
         "serve": {"hosts": slo_hosts, "totals": slo_totals},
     }
 
 
+# -- capacity decision plane --------------------------------------------------
+
+class CapacityPlanner:
+    """Scale-up / scale-down / hold recommendations with hysteresis —
+    the *decision* half of elastic capacity (ROADMAP item 3); actuation
+    stays with the operator.
+
+    Feed it successive :func:`aggregate` snapshots (``--watch`` does,
+    every pass) and it derives three signals:
+
+      - **queue depth per live host** (``queue.pending / live``): work
+        is piling up faster than the fleet drains it;
+      - **idle-wait stall share**: the fraction of fleet wall-time spent
+        in ``fleet.idle_wait`` (hosts starved while siblings hold the
+        last leases — more hosts would NOT help; fewer would);
+      - **SLO attainment + slope** over the observation window: serving
+        below target and not recovering means capacity, not luck, is
+        the problem.
+
+    Hysteresis keeps the recommendation actionable instead of flappy: a
+    non-``hold`` *pressure* must repeat ``confirm_ticks`` consecutive
+    observations before it becomes the recommendation, and once the
+    recommendation changes it is pinned for ``cooldown_s`` (scaling
+    actions take time to land; re-deciding mid-flight oscillates).
+    Thresholds and the clock are injectable for tests.
+    """
+
+    #: recommendation -> prometheus gauge value
+    SCALE = {"scale_up": 1, "hold": 0, "scale_down": -1}
+
+    def __init__(self, *, slo_target_pct: float = 95.0,
+                 up_pending_per_host: float = 2.0,
+                 down_idle_share: float = 0.5,
+                 confirm_ticks: int = 2, cooldown_s: float = 120.0,
+                 clock=time.time) -> None:
+        self.slo_target_pct = float(slo_target_pct)
+        self.up_pending_per_host = float(up_pending_per_host)
+        self.down_idle_share = float(down_idle_share)
+        self.confirm_ticks = max(1, int(confirm_ticks))
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._prev: Optional[dict] = None  # last observation's raw inputs
+        self._want: Optional[str] = None
+        self._streak = 0
+        self._recommendation = "hold"
+        self._last_change: Optional[float] = None
+
+    # -- signal derivation --------------------------------------------------
+    def _signals(self, agg: dict, now: float) -> dict:
+        live = int((agg.get("n_hosts") or {}).get("live") or 0)
+        q = agg.get("queue")
+        pending = claimed = None
+        if isinstance(q, dict):
+            pending = int(q.get("pending") or 0)
+            claimed = int(q.get("claimed") or 0)
+        pending_per_host = (round(pending / max(1, live), 3)
+                            if pending is not None else None)
+        # idle share: prefer the delta between this observation and the
+        # last (the live stall rate); first observation falls back to
+        # the cumulative share since fleet start
+        ci = agg.get("capacity_inputs") or {}
+        idle_now = float(ci.get("idle_wait_s_total") or 0.0)
+        up_now = float(ci.get("uptime_s") or 0.0)
+        idle_share = None
+        if ci.get("fleet_hosts"):
+            prev = self._prev or {}
+            d_idle = idle_now - float(prev.get("idle_wait_s_total", 0.0))
+            d_up = up_now - float(prev.get("uptime_s", 0.0))
+            if self._prev is not None and d_up > 0.5:
+                idle_share = max(0.0, min(1.0, d_idle / d_up))
+            elif up_now > 0:
+                idle_share = max(0.0, min(1.0, idle_now / up_now))
+        att = (agg.get("serve") or {}).get("totals", {}) \
+            .get("attainment_pct")
+        att = float(att) if att is not None else None
+        slope = None
+        if att is not None and self._prev is not None and \
+                self._prev.get("attainment_pct") is not None:
+            dt_min = (now - float(self._prev["time"])) / 60.0
+            if dt_min > 1e-3:
+                slope = round(
+                    (att - float(self._prev["attainment_pct"])) / dt_min, 3)
+        return {"live": live, "pending": pending, "claimed": claimed,
+                "pending_per_host": pending_per_host,
+                "idle_share": (round(idle_share, 4)
+                               if idle_share is not None else None),
+                "attainment_pct": att,
+                "attainment_slope_pct_per_min": slope,
+                "idle_wait_s_total": idle_now, "uptime_s": up_now,
+                "time": now}
+
+    def _pressure(self, s: dict) -> Tuple[str, List[str]]:
+        reasons: List[str] = []
+        want = "hold"
+        if s["pending"] and not s["live"]:
+            return "scale_up", [f"{s['pending']} item(s) pending with no "
+                                "live host"]
+        if s["pending_per_host"] is not None and \
+                s["pending_per_host"] >= self.up_pending_per_host:
+            want = "scale_up"
+            reasons.append(f"queue depth {s['pending_per_host']}/host >= "
+                           f"{self.up_pending_per_host}")
+        if s["attainment_pct"] is not None and \
+                s["attainment_pct"] < self.slo_target_pct and \
+                (s["attainment_slope_pct_per_min"] is None
+                 or s["attainment_slope_pct_per_min"] <= 0):
+            want = "scale_up"
+            reasons.append(
+                f"SLO attainment {s['attainment_pct']}% < "
+                f"{self.slo_target_pct}% and not recovering "
+                f"(slope {s['attainment_slope_pct_per_min']}%/min)")
+        if want == "hold" and s["live"] > 1 and s["pending"] == 0 and \
+                (s["claimed"] or 0) == 0 and s["idle_share"] is not None \
+                and s["idle_share"] >= self.down_idle_share:
+            want = "scale_down"
+            reasons.append(f"queue drained and idle-wait share "
+                           f"{s['idle_share']:.0%} >= "
+                           f"{self.down_idle_share:.0%}")
+        if not reasons:
+            reasons.append("signals inside bands")
+        return want, reasons
+
+    # -- the observation step ----------------------------------------------
+    def observe(self, agg: dict, now: Optional[float] = None) -> dict:
+        now = self.clock() if now is None else float(now)
+        s = self._signals(agg, now)
+        want, reasons = self._pressure(s)
+        if want == self._want:
+            self._streak += 1
+        else:
+            self._want, self._streak = want, 1
+        flipped = False
+        if want != self._recommendation:
+            confirmed = self._streak >= self.confirm_ticks
+            cooled = (self._last_change is None
+                      or now - self._last_change >= self.cooldown_s)
+            if confirmed and cooled:
+                self._recommendation = want
+                self._last_change = now
+                flipped = True
+            elif confirmed and not cooled:
+                reasons.append(
+                    f"pinned by cooldown ({self.cooldown_s:.0f}s since "
+                    "last change not elapsed)")
+            else:
+                reasons.append(
+                    f"awaiting confirmation ({self._streak}/"
+                    f"{self.confirm_ticks} consecutive)")
+        self._prev = {"idle_wait_s_total": s["idle_wait_s_total"],
+                      "uptime_s": s["uptime_s"],
+                      "attainment_pct": s["attainment_pct"], "time": now}
+        out = {"recommendation": self._recommendation,
+               "pressure": want, "streak": self._streak,
+               "changed": flipped, "reasons": reasons}
+        out.update({k: s[k] for k in ("live", "pending", "claimed",
+                                      "pending_per_host", "idle_share",
+                                      "attainment_pct",
+                                      "attainment_slope_pct_per_min")})
+        return out
+
+
+def render_capacity(rec: dict) -> List[str]:
+    lines = [f"== capacity ==  recommendation="
+             f"{rec['recommendation'].upper()}"
+             + (f"  (pressure={rec['pressure']} x{rec['streak']})"
+                if rec["pressure"] != rec["recommendation"] else "")]
+    sig = (f"  signals: live={rec['live']}")
+    if rec.get("pending") is not None:
+        sig += (f" pending={rec['pending']} "
+                f"({rec['pending_per_host']}/host)")
+    if rec.get("idle_share") is not None:
+        sig += f" idle_share={rec['idle_share']:.0%}"
+    if rec.get("attainment_pct") is not None:
+        sig += f" slo_attainment={rec['attainment_pct']}%"
+        if rec.get("attainment_slope_pct_per_min") is not None:
+            sig += f" (slope {rec['attainment_slope_pct_per_min']}%/min)"
+    lines.append(sig)
+    for r in rec.get("reasons", []):
+        lines.append(f"  - {r}")
+    return lines
+
+
 # -- rendering ----------------------------------------------------------------
 
-def render(agg: dict) -> List[str]:
+def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
     lines = [f"fleet report: {agg['root']}"]
     n = agg["n_hosts"]
     lines.append(
@@ -340,6 +550,18 @@ def render(agg: dict) -> List[str]:
             f"bypasses={ca['bypasses']}"
             + (f"  hit_rate={ca['hit_rate']}"
                if ca.get("hit_rate") is not None else ""))
+    cc = agg.get("compile_cache") or {}
+    if cc.get("attached_hosts") or cc.get("hits") or cc.get("misses"):
+        lines.append(
+            f"== compile cache ==  hits={cc.get('hits', 0)}  "
+            f"misses={cc.get('misses', 0)}  "
+            f"warm_hosts={cc.get('warm_hosts', 0)}/"
+            f"{cc.get('attached_hosts', 0)}"
+            + (f"  dropped={cc['dropped']}" if cc.get("dropped") else "")
+            + (f"  entries={','.join(cc['entries'])}"
+               if cc.get("entries") else ""))
+    if capacity is not None:
+        lines += render_capacity(capacity)
     fams = agg["families"]
     if fams:
         lines.append("== per-family throughput (fleet-wide spans) ==")
@@ -378,7 +600,7 @@ def render(agg: dict) -> List[str]:
 
 # -- prometheus export --------------------------------------------------------
 
-def build_prom_dump(agg: dict) -> dict:
+def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
     """Fleet-level gauges in the telemetry/metrics.py dump shape, so
     :func:`prometheus_text` renders them — one textfile for the whole
     fleet next to the per-host ones telemetry_report exports."""
@@ -410,6 +632,19 @@ def build_prom_dump(agg: dict) -> dict:
     for k in ("hits", "misses", "bypasses"):
         g(f"vft_fleet_cache_{k}_total", ca.get(k, 0))
     g("vft_fleet_cache_hit_rate", ca.get("hit_rate"))
+    cc = agg.get("compile_cache") or {}
+    for k in ("hits", "misses"):
+        g(f"vft_fleet_compile_cache_{k}_total", cc.get(k, 0))
+    g("vft_fleet_compile_cache_hit_rate", cc.get("hit_rate"))
+    g("vft_fleet_compile_cache_warm_hosts", cc.get("warm_hosts", 0))
+    if capacity is not None:
+        g("vft_fleet_capacity_recommendation",
+          CapacityPlanner.SCALE.get(capacity["recommendation"], 0))
+        g("vft_fleet_capacity_pressure",
+          CapacityPlanner.SCALE.get(capacity["pressure"], 0))
+        g("vft_fleet_capacity_pending_per_host",
+          capacity.get("pending_per_host"))
+        g("vft_fleet_capacity_idle_share", capacity.get("idle_share"))
     for fam, f in agg["families"].items():
         g("vft_fleet_family_done", f["done"], family=fam)
         g("vft_fleet_family_errors", f["error"], family=fam)
@@ -628,10 +863,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  {h}")
         return 0
 
+    # capacity decision plane: one planner across every --watch pass, so
+    # the hysteresis/slope state observes the fleet over real time (a
+    # one-shot report still gets the instantaneous pressure verdict)
+    planner = CapacityPlanner()
+    capacity = None
     passes = 0
     while True:
         agg = aggregate(args.root)
-        text = "\n".join(render(agg))
+        capacity = planner.observe(agg)
+        text = "\n".join(render(agg, capacity=capacity))
         if args.watch and passes > 0:
             # ANSI clear+home: the operator's top(1) for the fleet
             sys.stdout.write("\x1b[2J\x1b[H")
@@ -646,7 +887,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             break
 
     if args.prom:
-        dump = build_prom_dump(aggregate(args.root))
+        agg = aggregate(args.root)
+        capacity = planner.observe(agg)
+        dump = build_prom_dump(agg, capacity=capacity)
         with open(args.prom, "w", encoding="utf-8") as f:
             f.write(prometheus_text(dump))
         print(f"prometheus textfile: {args.prom} "

@@ -88,6 +88,12 @@ DONE_SCHEMA = "vft.fleet_done/1"
 #: many lease periods are recovered back into pending/
 STAGING_ORPHAN_LEASES = 4.0
 
+#: canary timing band for a compile-warm joining host: the generous
+#: default band exists to absorb cold-compile jitter, so a host whose
+#: compile-cache fingerprint fully hit (compile_cache.py) is held to
+#: this much tighter bar instead — it has no compile to pay
+WARM_CANARY_BAND = 0.25
+
 
 def _safe(name: str) -> str:
     """Filesystem-safe id (host ids embed hostnames, stems embed user
@@ -150,6 +156,16 @@ class WorkQueue:
                          "requeued": 0, "done": 0, "quarantined": 0,
                          "lease_lost": 0, "duplicate_discarded": 0}
         self._canary_state = "off"
+        #: set by the driver when this host's compile-cache fingerprint
+        #: fully hit at attach (compile_cache.py): the canary gate drops
+        #: its cold-compile timing allowance, and the heartbeat fleet
+        #: section records it for vft-fleet
+        self.canary_warm = False
+        #: cumulative seconds this host spent idle-waiting on other
+        #: hosts' live leases (the drain loop's fleet.idle_wait spans,
+        #: summed) — the stall-share input to the capacity planner
+        #: (fleet_report.py CapacityPlanner)
+        self._idle_wait_s = 0.0
 
     # -- path helpers -------------------------------------------------------
     def _p(self, *parts: str) -> str:
@@ -568,11 +584,15 @@ class WorkQueue:
         now = self.clock()
         oldest = max((now - float(r.get("claim_time", now))
                       for r in active.values()), default=0.0)
+        with self._lock:
+            idle_s = self._idle_wait_s
         return {"mode": "queue", "lease_s": self.lease_s,
                 "host_id": self.host_id,
                 "active_claims": len(active),
                 "oldest_active_claim_age_s": round(oldest, 3),
                 "queue": self.counts(), "canary": self._canary_state,
+                "canary_warm": bool(self.canary_warm),
+                "idle_wait_s_total": round(idle_s, 3),
                 **tallies}
 
     # -- the drain loop ------------------------------------------------------
@@ -599,7 +619,11 @@ class WorkQueue:
                     if self.all_done():
                         return
                     with trace.span("fleet.idle_wait"):
+                        t_idle = time.perf_counter()
                         stop.wait(poll_s)
+                        with self._lock:
+                            self._idle_wait_s += \
+                                time.perf_counter() - t_idle
                     continue
                 video = rec.get("video")
                 t0 = time.perf_counter()
@@ -655,6 +679,16 @@ class WorkQueue:
         against, and the run-level health gates still apply."""
         self._canary_state = "running"
         lines: List[str] = []
+        if self.canary_warm:
+            # warm fast path (compile_cache.py): the generous default
+            # timing band exists to absorb a joining host's cold XLA
+            # compiles; a fully-hit compile-cache fingerprint means there
+            # are none to absorb, so the re-compile allowance is skipped
+            # and the gate is held to the tight band instead
+            band = min(float(band), WARM_CANARY_BAND)
+            lines.append("fleet canary: compile cache warm (fingerprint "
+                         "fully hit) — cold-compile allowance removed, "
+                         f"timing band tightened to {band:.0%}")
         sample = []
         try:
             names = sorted(n for n in os.listdir(self._p(DONE))
@@ -695,6 +729,7 @@ class WorkQueue:
         ok = self._canary_timing(canary_dir, results, band, lines) and ok
         verdict = {"schema": "vft.fleet_canary/1", "host_id": self.host_id,
                    "run_id": self.run_id, "ok": bool(ok),
+                   "canary_warm": bool(self.canary_warm),
                    "videos": [str(r.get("video")) for r, _, _ in results],
                    "time": round(self.clock(), 3), "lines": lines}
         write_json_atomic(self._p("canary", f"{_safe(self.host_id)}.json"),

@@ -252,6 +252,17 @@ class ServeLoop:
         self._answered = 0
         self._slo_violations = 0
 
+        # fleet-shared compile cache (compile_cache.py): attach BEFORE
+        # the warm construction below so its init-time compiles land in
+        # the entry — a restarted server re-attaches warm and its first
+        # request after a crash or deploy contains no compile, which is
+        # the whole point of the serve mode; sealed when run() exits
+        from . import compile_cache
+        self.compile_cache_entry = (
+            compile_cache.attach_for_multi_args(per_family)
+            if per_family is not None
+            else compile_cache.attach_for_args(args.feature_type, args))
+
         # -- warm construction: params resident for the process lifetime --
         if per_family is not None:
             from .extractors.multi import MultiExtractor
@@ -697,6 +708,10 @@ class ServeLoop:
                 # atomic temp+rename at close — an aborted server still
                 # leaves a complete, stitchable trace behind
                 self.tracer.close()
+            # seal the compile-cache entry: the restarted server (or any
+            # fleet sibling with the same fingerprint) attaches warm
+            from . import compile_cache
+            compile_cache.seal_active()
         return 143 if self._stop.is_set() else 0
 
     def stop(self) -> None:

@@ -283,6 +283,11 @@ class TelemetryRecorder:
             # hit/miss/bypass totals + overall hit rate — the first-class
             # bench number ISSUE 7 makes of repeat-content avoidance
             "cache": self.cache_snapshot(),
+            # compile-cache effectiveness (compile_cache.py): XLA
+            # hit/miss deltas this run + the attached entry's identity
+            # and warmth — how vft-fleet proves a joining host skipped
+            # its compiles (ISSUE 11)
+            "compile_cache": self.compile_cache_snapshot(),
         }
         for name, fn in list(self.extra_sections.items()):
             try:
@@ -311,6 +316,26 @@ class TelemetryRecorder:
         hits = sum(out["hits"].values())
         consulted = hits + sum(out["misses"].values())
         out["hit_rate"] = round(hits / consulted, 4) if consulted else None
+        return out
+
+    def compile_cache_snapshot(self) -> dict:
+        """XLA compile-cache counters since run start (the jax.monitoring
+        listeners' delta) plus — when this process attached a
+        fleet-shared entry (compile_cache.py) — its key, warmth at
+        attach, and the verify verdicts. ``hits > 0, misses == 0`` is
+        the warm-start acceptance shape."""
+        s = compile_cache_summary(self._mon_baseline)
+        out: Dict[str, object] = {"hits": int(s.get("hits", 0)),
+                                  "misses": int(s.get("misses", 0))}
+        try:
+            from ..compile_cache import active_info
+            info = active_info()
+        except Exception:
+            info = None
+        if info is not None:
+            out.update(entry=info["entry"], family=info["family"],
+                       warm_at_attach=info["warm_at_attach"],
+                       verified=info["verified"], dropped=info["dropped"])
         return out
 
     def fanout_snapshot(self) -> dict:
@@ -358,5 +383,11 @@ class TelemetryRecorder:
             failure_tallies=failure_tallies,
             stage_totals=stage_totals,
             metrics_dump=self.registry.to_dict(),
-            compile_cache=compile_cache_summary(self._mon_baseline),
+            # raw event deltas PLUS the attached fleet-entry identity
+            # (compile_cache.py), so the manifest alone answers "did
+            # this host join warm" (hits/misses keys win over raw names)
+            compile_cache={**compile_cache_summary(self._mon_baseline),
+                           **{k: v for k, v in
+                              (self.compile_cache_snapshot()).items()
+                              if k not in ("hits", "misses")}},
         )

@@ -106,6 +106,7 @@ def bench_ours(batch: int = BATCH) -> float:
     wire = (batch, CLIP[0], packed_size(CLIP[1], CLIP[2]))
     batches = [jax.device_put(rng.integers(0, 255, size=wire, dtype=np.uint8))
                for _ in range(2)]
+    _record_cost(f"r21d_b{batch}", forward, (params, batches[0]))
     settle(forward(params, batches[0]))  # compile
     for _ in range(WARMUP):
         settle(forward(params, batches[1]))
@@ -145,6 +146,63 @@ def bench_torch_reference() -> float:
                     break
             best = max(best, n / dt)
     return best
+
+
+# ---- roofline fields on every device row (ISSUE 12) ----------------------
+#
+# Each device bench registers its jitted step's XLA cost card here
+# (telemetry/roofline.py program_cost — the same lowered.cost_analysis()
+# arithmetic behind the old hand table in docs/performance.md), and
+# main() stamps mfu/effective_tflops onto the row from the measured rate,
+# so bench_history's regression gate guards device EFFICIENCY, not just
+# throughput: a change that kept clips/s by burning 2x the FLOPs — or
+# halved MFU on a faster chip — flags.
+
+PROGRAM_COSTS = {}
+
+
+def _record_cost(key: str, step, args) -> None:
+    """Capture one jitted step's {flops, bytes} per dispatch under
+    ``key``; never fails the bench (cost is accounting, not the metric)."""
+    try:
+        from video_features_tpu.telemetry.roofline import program_cost
+        PROGRAM_COSTS[key] = program_cost(step, *args)
+    except Exception as e:
+        print(f"WARNING: cost capture failed for {key}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+
+
+_PEAK_CACHE = []
+
+
+def _device_peak():
+    """This process's MFU denominator (telemetry/roofline.py
+    peak_for_device: registry -> cached microbench -> microbench),
+    resolved once per bench run."""
+    if not _PEAK_CACHE:
+        try:
+            from video_features_tpu.telemetry.roofline import peak_for_device
+            _PEAK_CACHE.append(peak_for_device())
+        except Exception as e:
+            print(f"WARNING: device peak resolution failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _PEAK_CACHE.append(None)
+    return _PEAK_CACHE[0]
+
+
+def _roofline_fields(key: str, units_per_s, units_per_dispatch: int) -> dict:
+    """``{effective_tflops, mfu}`` for a row whose jitted step was
+    cost-registered under ``key`` — empty when capture failed, so a row
+    never lies with zeros."""
+    c = PROGRAM_COSTS.get(key)
+    if not c or not c.get("flops") or not units_per_s:
+        return {}
+    eff = units_per_s * (c["flops"] / units_per_dispatch) / 1e12
+    out = {"effective_tflops": round(eff, 4)}
+    peak = _device_peak()
+    if peak and peak.get("peak_tflops"):
+        out["mfu"] = round(eff / peak["peak_tflops"], 4)
+    return out
 
 
 def _device_rate(step, args_list, units_per_iter, iters: int,
@@ -259,6 +317,7 @@ def bench_i3d_ours(stack: int = I3D_STACK, iters: int = 10,
         0, 255, size=(n_stacks, stack + 1, I3D_SIDE, I3D_SIDE, 3),
         dtype=np.uint8)) for _ in range(2)]
     args = [(raft_p, i3d_rgb, i3d_flow, s) for s in stacks]
+    _record_cost(f"i3d_raft{'_bf16' if raft_bf16 else ''}", step, args[0])
     return _device_rate(step, args, n_stacks, iters, warmup)
 
 
@@ -309,6 +368,7 @@ def bench_i3d_pwc_ours(stack: int = I3D_STACK, iters: int = 10,
         0, 255, size=(n_stacks, stack + 1, I3D_SIDE, I3D_SIDE, 3),
         dtype=np.uint8)) for _ in range(2)]
     args = [(pwc_p, i3d_rgb, i3d_flow, s) for s in stacks]
+    _record_cost("i3d_pwc", step, args[0])
     return _device_rate(step, args, n_stacks, iters, warmup)
 
 
@@ -521,6 +581,57 @@ def bench_health_overhead(families=("resnet", "clip", "s3d"),
         run("warm", [])  # weights, compiles, persistent cache
         off = run("off", ["health=false"])
         on = run("on", ["health=true"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
+def bench_roofline_overhead(families=("resnet", "clip", "s3d"),
+                            n_copies: int = 2) -> dict:
+    """Wall-clock cost of roofline=true (telemetry/roofline.py) on the
+    same smoke corpus as bench_trace_overhead: the multi-family CLI run,
+    warmed untimed (which also seeds the per-device-kind peak cache, so
+    the timed run never pays the 2048^3 microbench), then timed with
+    roofline=false and roofline=true into fresh output dirs. The
+    instrumented paths are one AOT lowering per (runner, batch shape) —
+    once, at first dispatch — plus a dict hit per further dispatch and
+    the chained stage hook; the acceptance bar is <= 1.05x like the
+    other always-on observability knobs."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the roofline bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_roofline_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_roofline{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        # warm pass WITH roofline: weights, compiles, persistent cache,
+        # and the device peak cache all hot before the timed A/B
+        run("warm", ["roofline=true"])
+        off = run("off", ["roofline=false"])
+        on = run("on", ["roofline=true"])
     return {"families": list(families), "n_copies": n_copies,
             "off_s": round(off, 2), "on_s": round(on, 2),
             "overhead_ratio": round(on / off, 3)}
@@ -1395,6 +1506,7 @@ def bench_resnet50(batch: int = 128, iters: int = 20):
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.integers(0, 255, size=(batch, 224, 224, 3),
                                         dtype=np.uint8)) for _ in range(2)]
+    _record_cost("resnet50", step, (params, data[0]))
     ours = _device_rate(step, [(params, d) for d in data], batch, iters)
 
     def torch_baseline():
@@ -1422,6 +1534,7 @@ def bench_clip_vit_b32(batch: int = 128, iters: int = 20):
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.integers(0, 255, size=(batch, 224, 224, 3),
                                         dtype=np.uint8)) for _ in range(2)]
+    _record_cost("clip", step, (params, data[0]))
     ours = _device_rate(step, [(params, d) for d in data], batch, iters)
 
     def torch_baseline():
@@ -1457,6 +1570,7 @@ def bench_s3d(batch: int = 8, stack: int = 64, iters: int = 10):
     data = [jax.device_put(rng.integers(
         0, 255, size=(batch, stack, 224, 224, 3), dtype=np.uint8))
         for _ in range(2)]
+    _record_cost("s3d", step, (params, data[0]))
     ours = _device_rate(step, [(params, d) for d in data], batch, iters)
 
     def torch_baseline():
@@ -1485,6 +1599,7 @@ def bench_vggish(batch: int = 256, iters: int = 20):
     rng = np.random.default_rng(0)
     data = [jax.device_put(rng.standard_normal(
         (batch, 96, 64, 1)).astype(np.float32)) for _ in range(2)]
+    _record_cost("vggish", step, (params, data[0]))
     ours = _device_rate(step, [(params, d) for d in data], batch, iters)
 
     def torch_baseline():
@@ -1537,6 +1652,8 @@ def _raft_standalone_pair():
     # (extractors/base.py), which would silently upcast this variant
     step16 = jax.jit(lambda p, x: _with_default(_raft_forward, m16, p, x))
 
+    _record_cost("raft_f32", step32, (params, data[0]))
+    _record_cost("raft_bf16", step16, (p16, data[0]))
     f32_v, bf16_v = _device_rate_ab(
         [(step32, [(params, d) for d in data]),
          (step16, [(p16, d) for d in data])], batch, iters)
@@ -1597,6 +1714,8 @@ def _pwc_standalone_pair():
     step32 = jax.jit(lambda p, x: _with_highest(_pwc_forward, m32, p, x))
     step16 = jax.jit(lambda p, x: _with_default(_pwc_forward, m16, p, x))
     args = [(params, d) for d in data]
+    _record_cost("pwc_f32", step32, args[0])
+    _record_cost("pwc_bf16", step16, args[0])
     f32_v, bf16_v = _device_rate_ab(
         [(step32, args), (step16, args)], batch, iters)
     _FLOW_PAIRS["pwc"] = (f32_v, bf16_v, None)
@@ -1651,6 +1770,9 @@ def main() -> None:
         "note": "program unchanged since round 3: treat any delta vs "
                 "BENCH_r03 as tunnel jitter (no cross-binary interleaved "
                 "A/B was run; docs/performance.md measurement discipline)",
+        # device-efficiency fields (ISSUE 12): XLA-cost-model FLOPs x
+        # measured rate / peak registry — under the bench-history gate
+        **_roofline_fields(f"r21d_b{BATCH}", ours, BATCH),
     }
     metrics = [r21d_entry]
     # the bf16-raft row is the precision=bfloat16 flow-stream mode: flow
@@ -1668,11 +1790,12 @@ def main() -> None:
                 "(bench_i3d_variants.py): raft-s4f 6.28 / pwc-f32 5.86 / "
                 "pwc-bf16x4 12.08 stacks/s — pwc default is now measured, "
                 "not inherited")
-    for label, value, flow_kind, note in (
-            ("bf16 i3d / f32 raft", i3d, "raft", i3d_note),
-            ("bf16 i3d + bf16 raft", i3d_bf, "raft", i3d_note),
+    for label, value, flow_kind, cost_key, note in (
+            ("bf16 i3d / f32 raft", i3d, "raft", "i3d_raft", i3d_note),
+            ("bf16 i3d + bf16 raft", i3d_bf, "raft", "i3d_raft_bf16",
+             i3d_note),
             ("bf16 i3d + bf16 pwc, DEFAULT config", i3d_pwc, "pwc",
-             pwc_note)):
+             "i3d_pwc", pwc_note)):
         if value is None:
             continue
         # the torch baseline runs the reference's RAFT flow; a PWC-flow
@@ -1688,6 +1811,7 @@ def main() -> None:
             "vs_baseline": round(ratio, 2) if ratio is not None else None,
             "baseline": BASELINE_DESC,
             "note": note,
+            **_roofline_fields(cost_key, value, 4),
         })
 
     # ---- per-family rows (round-4: every family gets a number) ----------
@@ -1698,27 +1822,29 @@ def main() -> None:
         # MFU breakdown). Headline row stays B=128 for cross-round
         # comparability; this row records the wider-batch ceiling.
         ("r2plus1d_18 16f@112px clip throughput, B=512 wide-batch",
-         lambda: (bench_ours(batch=512), None), "clips/sec/chip", None),
+         lambda: (bench_ours(batch=512), None), "clips/sec/chip", None,
+         ("r21d_b512", 512)),
         ("resnet50 224px frame throughput", bench_resnet50,
-         "frames/sec/chip", None),
+         "frames/sec/chip", None, ("resnet50", 128)),
         ("clip ViT-B/32 224px frame throughput", bench_clip_vit_b32,
-         "frames/sec/chip", None),
+         "frames/sec/chip", None, ("clip", 128)),
         ("s3d 64f@224px stack throughput", bench_s3d,
-         "stacks/sec/chip", None),
+         "stacks/sec/chip", None, ("s3d", 8)),
         ("vggish 0.96s log-mel example throughput", bench_vggish,
-         "examples/sec/chip", None),
+         "examples/sec/chip", None, ("vggish", 256)),
         # the f32/bf16 pairs below come from ONE interleaved measurement
         # each (_device_rate_ab): a sequential pair of rows can land in
         # different tunnel phases and invert the real ordering
         ("raft sintel 20-iter flow @240x320 (f32, matmul=highest)",
          lambda: (_raft_standalone_pair()[0], _raft_standalone_pair()[2]),
-         "pairs/sec/chip", None),
+         "pairs/sec/chip", None, ("raft_f32", 32)),
         # bf16 raft: no torch ratio — the baseline is f32 numerics, and
         # the f32 row above already carries it for the same work unit
         ("raft sintel 20-iter flow @240x320 (opt-in precision=bfloat16, "
          "~0.1 px drift)",
          lambda: (_raft_standalone_pair()[1], None),
-         "pairs/sec/chip", "interleaved with the f32 row"),
+         "pairs/sec/chip", "interleaved with the f32 row",
+         ("raft_bf16", 32)),
         ("pwc flow @256x448 (f32, standalone default)",
          lambda: (_pwc_standalone_pair()[0], None), "pairs/sec/chip",
          "no torch-cpu baseline EXISTS: the reference PWC correlation is "
@@ -1726,12 +1852,12 @@ def main() -> None:
          "running at all without a GPU/second conda env is the parity "
          "delta. Treat cross-ROUND deltas on this row with suspicion "
          "(tunnel jitter spans 10x between runs); the f32-vs-bf16 pair "
-         "below is interleaved and trustworthy"),
+         "below is interleaved and trustworthy", ("pwc_f32", 32)),
         ("pwc flow @256x448 (opt-in precision=bfloat16, 0.015 px drift)",
          lambda: (_pwc_standalone_pair()[1], None), "pairs/sec/chip",
-         "interleaved with the f32 row"),
+         "interleaved with the f32 row", ("pwc_bf16", 32)),
     ]
-    for name, fn, unit, note in families:
+    for name, fn, unit, note, cost in families:
         try:
             value, torch_fn = fn()
         except Exception as e:
@@ -1756,6 +1882,8 @@ def main() -> None:
         }
         if note:
             row["note"] = note
+        if cost is not None:
+            row.update(_roofline_fields(cost[0], value, cost[1]))
         metrics.append(row)
     # sustained real-pipeline number (decode -> device -> sink): the
     # deliverable throughput next to the device-only steady state;
@@ -1845,6 +1973,29 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: health-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # roofline accounting (telemetry/roofline.py): one AOT lowering per
+    # program shape + a dict hit per dispatch + the chained stage hook —
+    # the fifth always-on observability knob held to the same <= 1.05x
+    # budget, bench-history gated
+    try:
+        rfo = bench_roofline_overhead()
+        metrics.append({
+            "metric": "roofline accounting overhead (roofline=true vs "
+                      f"off, {'+'.join(rfo['families'])})",
+            "value": rfo["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": rfo["off_s"],
+            "on_s": rfo["on_s"],
+            "note": f"{rfo['n_copies']}x sample, extraction_fps=4, warmed "
+                    "(incl. the device peak cache), fresh outputs; cost "
+                    "cards lower once per (runner, batch shape), every "
+                    "further dispatch is a dict hit (docs/observability.md "
+                    "'The roofline pillar')",
+        })
+    except Exception as e:
+        print(f"WARNING: roofline-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # fault-injection sites (utils/inject.py): the off path is permanent
     # production code on the sink/decode/queue hot paths, so its cost is
@@ -2044,9 +2195,16 @@ def main() -> None:
     seen_names = set()
 
     def compact(row):
+        # "unit" and "effective_tflops" live only in BENCH_full.json: the
+        # 2,000-char driver tail was already at 1,942 before the roofline
+        # fields, and every direction-of-goodness case bench_history
+        # handles survives on the metric NAME alone (overhead rows all
+        # say "overhead"; mfu is its own keep so per-row device
+        # efficiency stays under the regression gate — effective_tflops
+        # is mfu x a per-device constant, so guarding one guards both)
         out = {k: v for k, v in row.items()
-               if k in ("metric", "value", "unit", "vs_baseline",
-                        "videos_per_s")
+               if k in ("metric", "value", "vs_baseline",
+                        "videos_per_s", "mfu")
                and v is not None}
         # 60-char cap keeps the WHOLE line inside the driver's 2,000-char
         # tail as rows accumulate; BENCH_full.json keeps full names. On a

@@ -90,7 +90,8 @@ def parse_round(text: str, source: str) -> Optional[dict]:
         "source": os.path.basename(source),
         "recorded_time": round(time.time(), 3),
         "headline": {k: parsed.get(k) for k in
-                     ("metric", "value", "unit", "vs_baseline")},
+                     ("metric", "value", "unit", "vs_baseline",
+                      "mfu", "effective_tflops")},
         "metrics": [m for m in parsed.get("metrics", [])
                     if isinstance(m, dict) and "metric" in m],
     }
@@ -139,19 +140,43 @@ def append_rounds(path: str, inputs: List[str]) -> int:
 
 # -- regression check -------------------------------------------------------
 
+#: device-efficiency fields bench.py stamps on its rows (ISSUE 12:
+#: telemetry/roofline.py) — each becomes its OWN derived series so the
+#: regression gate guards efficiency, not just the row's primary value
+EFFICIENCY_FIELDS = ("mfu", "effective_tflops")
+
+
 def _rows(rec: dict) -> List[dict]:
     rows = []
     h = rec.get("headline") or {}
     if h.get("metric") is not None and h.get("value") is not None:
         rows.append(h)
     rows += [m for m in rec.get("metrics", []) if m.get("value") is not None]
-    return rows
+    # mfu/effective_tflops ride throughput rows as extra fields; split
+    # them into "<row> [mfu]"-style series of their own, with the field
+    # name as the unit so lower_is_better classifies them by field (a
+    # parent row named "...overhead..." must not flip its mfu series)
+    derived = []
+    for row in rows:
+        for key in EFFICIENCY_FIELDS:
+            v = row.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                derived.append({"metric": f"{row['metric']} [{key}]",
+                                "value": v, "unit": key})
+    return rows + derived
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
-    """Overhead/latency rows regress UP; everything bench.py emits today
-    is otherwise a higher-is-better throughput or sharing ratio."""
+    """Overhead/latency rows regress UP; device-efficiency series (the
+    roofline fields: MFU, effective TFLOPS) regress DOWN like the
+    throughputs they ride — checked FIRST so an efficiency series split
+    off an overhead-named row keeps its direction; everything else
+    bench.py emits is a higher-is-better throughput or sharing ratio."""
+    if unit in EFFICIENCY_FIELDS:
+        return False
     text = f"{metric} {unit}".lower()
+    if "mfu" in text or "tflops" in text:
+        return False
     return "overhead" in text or "wall-clock" in text \
         or "seconds per" in text
 

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Roofline quick-gate: emitter and JSON Schema agree, and a real
+``roofline=true`` CPU smoke emits a verdict-bearing document.
+
+Fourth sibling of ``check_telemetry_schema.py`` / ``check_trace_schema.py``
+/ ``check_health_schema.py``, for the MFU-accounting pillar
+(telemetry/roofline.py). Two halves:
+
+  1. **static**: ``roofline.schema.json`` properties equal the emitter's
+     field lists (``ROOFLINE_FIELDS`` / ``DEVICE_FIELDS`` /
+     ``FAMILY_FIELDS`` / ``CARD_FIELDS``), the verdict enum equals
+     ``VERDICTS`` (+ null), the schema tag matches, and a synthetic
+     observer document (toy jitted program through the real
+     ``DataParallelApply`` dispatch hook) has exactly the declared keys
+     and validates via the dependency-free validator
+     (telemetry/schema.py);
+  2. **dynamic**: a single-family resnet CPU smoke over the vendored
+     sample with ``roofline=true telemetry=true`` must write a valid
+     ``_roofline.json`` whose resnet family carries cost cards with
+     XLA-reported FLOPs, an effective-TFLOPS/MFU pair, and a verdict
+     from the four-member set — and the manifest + heartbeat must carry
+     the ``roofline`` section. The peak is pinned via
+     ``VFT_ROOFLINE_PEAK`` so the gate never runs the 2048^3 microbench.
+
+Exit 0 = in sync; exit 1 = drift, every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml).
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from video_features_tpu.telemetry import roofline  # noqa: E402
+from video_features_tpu.telemetry import schema as tschema  # noqa: E402
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+
+def _props_match(sch: dict, fields, label: str) -> List[str]:
+    errs: List[str] = []
+    props = set(sch.get("properties", {}))
+    want = set(fields)
+    if props != want:
+        only_schema = sorted(props - want)
+        only_emitter = sorted(want - props)
+        if only_schema:
+            errs.append(f"{label}: schema-only properties (emitter never "
+                        f"writes them): {only_schema}")
+        if only_emitter:
+            errs.append(f"{label}: emitter fields missing from schema: "
+                        f"{only_emitter}")
+    missing_req = sorted(set(sch.get("required", [])) - props)
+    if missing_req:
+        errs.append(f"{label}: required keys not in properties: "
+                    f"{missing_req}")
+    return errs
+
+
+def check_static() -> List[str]:
+    errs: List[str] = []
+    try:
+        sch = roofline.load_roofline_schema()
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"cannot load {roofline.ROOFLINE_SCHEMA_PATH}: "
+                f"{type(e).__name__}: {e}"]
+    errs += _props_match(sch, roofline.ROOFLINE_FIELDS, "top-level")
+    if sch.get("additionalProperties", True) is not False:
+        errs.append("top-level schema must set additionalProperties: false")
+    tag_enum = sch.get("properties", {}).get("schema", {}).get("enum")
+    if tag_enum != [roofline.SCHEMA_VERSION]:
+        errs.append(f"schema tag enum {tag_enum} != "
+                    f"[{roofline.SCHEMA_VERSION!r}]")
+    dev = sch.get("properties", {}).get("device", {})
+    errs += _props_match(dev, roofline.DEVICE_FIELDS, "device")
+    fam = sch.get("properties", {}).get("families", {}) \
+        .get("additionalProperties", {})
+    errs += _props_match(fam, roofline.FAMILY_FIELDS, "family")
+    card = fam.get("properties", {}).get("programs", {}).get("items", {})
+    errs += _props_match(card, roofline.CARD_FIELDS, "program card")
+    verdict_enum = fam.get("properties", {}).get("verdict", {}).get("enum")
+    if verdict_enum is None or \
+            [v for v in verdict_enum if v is not None] != \
+            list(roofline.VERDICTS):
+        errs.append(f"verdict enum {verdict_enum} != VERDICTS "
+                    f"{list(roofline.VERDICTS)} (+ null)")
+
+    # a real emitted document: toy jitted program through the actual
+    # DataParallelApply dispatch hook, summarized and validated
+    import numpy as np
+    from video_features_tpu.parallel.mesh import (DataParallelApply,
+                                                  get_mesh)
+    with tempfile.TemporaryDirectory(prefix="vft_roofline_gate_") as td:
+        os.environ.setdefault("VFT_ROOFLINE_PEAK", "0.05,10")
+        obs = roofline.RooflineObserver(td, default_family="check",
+                                        run_id="gate", host_id=None)
+        if obs.start() is not obs:
+            return errs + ["another roofline observer is active — the "
+                           "gate must run in a fresh process"]
+        try:
+            runner = DataParallelApply(lambda p, x: x @ p,
+                                       np.ones((16, 16), np.float32),
+                                       mesh=get_mesh(n_devices=1))
+            runner(np.ones((4, 16), np.float32))
+            runner(np.ones((4, 16), np.float32))
+            doc = obs.close()
+        finally:
+            obs.close(write=False)
+        if doc is None:
+            return errs + ["observer close() returned no document"]
+        if set(doc) != set(roofline.ROOFLINE_FIELDS):
+            errs.append(f"emitted document keys "
+                        f"{sorted(set(doc) ^ set(roofline.ROOFLINE_FIELDS))}"
+                        " differ from ROOFLINE_FIELDS")
+        fam_doc = (doc.get("families") or {}).get("check")
+        if not fam_doc:
+            errs.append("toy dispatch produced no 'check' family")
+        elif set(fam_doc) != set(roofline.FAMILY_FIELDS):
+            errs.append(f"family keys "
+                        f"{sorted(set(fam_doc) ^ set(roofline.FAMILY_FIELDS))}"
+                        " differ from FAMILY_FIELDS")
+        errs.extend(tschema.validate(doc, sch))
+    return errs
+
+
+def check_smoke() -> List[str]:
+    if not SAMPLE.exists():
+        print(f"roofline smoke SKIP: vendored sample missing at {SAMPLE}")
+        return []
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    # pin the peak: the gate asserts the accounting plumbing, not this
+    # CI machine's matmul rate — and must never pay the microbench
+    os.environ["VFT_ROOFLINE_PEAK"] = "0.05,10"
+    with tempfile.TemporaryDirectory(prefix="vft_roofline_gate_") as td:
+        out, tmp = Path(td) / "out", Path(td) / "tmp"
+        with contextlib.redirect_stdout(sys.stderr):
+            cli_main([
+                "feature_type=resnet", "model_name=resnet18", "device=cpu",
+                "allow_random_weights=true", "on_extraction=save_numpy",
+                "batch_size=8", "extraction_total=6", "retry_attempts=1",
+                f"output_path={out}", f"tmp_path={tmp}",
+                f"video_paths={SAMPLE}",
+                "roofline=true", "telemetry=true", "metrics_interval_s=60",
+            ])
+        run_dir = out / "resnet" / "resnet18"
+        rpath = run_dir / roofline.ROOFLINE_FILENAME
+        if not rpath.exists():
+            return [f"{rpath} was not written by the roofline=true smoke"]
+        doc = json.load(open(rpath))
+        errs.extend(roofline.validate_roofline(doc))
+        fam = (doc.get("families") or {}).get("resnet")
+        if not fam:
+            errs.append("_roofline.json has no resnet family")
+        else:
+            if not fam.get("programs") or \
+                    not any(c.get("flops") for c in fam["programs"]):
+                errs.append("resnet family has no FLOP-bearing cost card "
+                            f"(programs={fam.get('programs')!r})")
+            if fam.get("effective_tflops") is None or \
+                    fam.get("mfu") is None:
+                errs.append("resnet family missing effective_tflops/mfu "
+                            f"({fam.get('effective_tflops')!r}/"
+                            f"{fam.get('mfu')!r})")
+            if fam.get("verdict") not in roofline.VERDICTS:
+                errs.append(f"resnet verdict {fam.get('verdict')!r} not "
+                            f"in {list(roofline.VERDICTS)}")
+        man_path = run_dir / "_run.json"
+        if not man_path.exists():
+            errs.append("no _run.json manifest from the smoke run")
+        else:
+            man = json.load(open(man_path))
+            if "resnet" not in ((man.get("roofline") or {})
+                                .get("families") or {}):
+                errs.append("manifest 'roofline' section missing the "
+                            "resnet family")
+        hbs = glob.glob(str(run_dir / "_heartbeat_*.json"))
+        if not hbs:
+            errs.append("no heartbeat from the smoke run")
+        else:
+            hb = json.load(open(hbs[0]))
+            if "resnet" not in ((hb.get("roofline") or {})
+                                .get("families") or {}):
+                errs.append("heartbeat 'roofline' section missing the "
+                            "resnet family")
+        # the report must render a table naming the family + verdict
+        agg = roofline.aggregate_rooflines(str(run_dir))
+        if agg is None or "resnet" not in (agg.get("families") or {}):
+            errs.append("vft-roofline aggregation found no resnet family")
+        else:
+            table = "\n".join(roofline.render_table(agg))
+            if "resnet" not in table or "-bound" not in table:
+                errs.append("vft-roofline table missing family/verdict: "
+                            + table)
+    return errs
+
+
+def main() -> int:
+    errs = check_static()
+    if not errs:
+        errs += check_smoke()
+    if errs:
+        print("roofline schema/emitter DRIFT:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"roofline gate OK: {len(roofline.ROOFLINE_FIELDS)}+"
+          f"{len(roofline.FAMILY_FIELDS)}+{len(roofline.CARD_FIELDS)} "
+          f"fields in sync ({roofline.ROOFLINE_SCHEMA_PATH}); "
+          "roofline=true smoke emitted cost cards, MFU and a verdict")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

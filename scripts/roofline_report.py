@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""vft-roofline, checkout form: the per-family MFU table + verdicts.
+
+Renders a ``roofline=true`` run's (or whole fleet's) ``_roofline*.json``
+artifacts into the auto-generated MFU table that replaced the
+hand-computed one in docs/performance.md: XLA-cost-model FLOPs and
+bytes per dispatched program, measured forward/h2d seconds, effective
+TFLOPS, MFU against the device peak registry, and one of the four
+roofline verdicts per family — compute-bound / bandwidth-bound /
+launch-overhead-bound / host-bound (sandbagged).
+
+    python scripts/roofline_report.py {output_path}
+    python scripts/roofline_report.py {output_path} --profile /tmp/jaxtrace
+    python scripts/roofline_report.py {output_path} --json
+
+``--profile`` adds the per-op device-time breakdown from a
+``jax.profiler`` capture (``profile_trace_dir=``) — where inside the
+program the time goes, next to the per-program cards.
+
+Thin wrapper over ``video_features_tpu.telemetry.roofline`` (also
+installed as the ``vft-roofline`` console script) so an operator on a
+bare checkout can run it like the other scripts/ tools. See
+docs/observability.md "The roofline pillar".
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.telemetry.roofline import report_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(report_main())

@@ -89,6 +89,16 @@ def render_manifest(man: dict) -> List[str]:
             f"  health[{fam}]: {h.get('records', 0)} digests, "
             f"{h.get('nan', 0)} NaN / {h.get('inf', 0)} Inf"
             + (f"  ({bad} NON-FINITE record(s))" if bad else ""))
+    for fam, f in sorted(((man.get("roofline") or {})
+                          .get("families") or {}).items()):
+        mfu = f.get("mfu")
+        verdict = f.get("verdict")
+        lines.append(
+            f"  roofline[{fam}]: "
+            + (f"mfu={100 * mfu:.1f}%" if mfu is not None else "mfu=?")
+            + (f"  eff={f.get('effective_tflops')} TFLOPS"
+               if f.get("effective_tflops") is not None else "")
+            + f"  {'host-bound (sandbagged)' if verdict == 'host-bound' else verdict or '?'}")
     totals = man.get("stage_totals", {})
     if totals:
         acc = sum(v.get("s", 0.0) for v in totals.values()) or 1.0
@@ -192,6 +202,24 @@ def render_heartbeats(paths: List[str], now: float,
         # doing/stealing the work, and — via the straggler flag — which
         # one the rest of the fleet is idling behind, without opening a
         # trace
+        # roofline accounting (telemetry/roofline.py): per-family MFU %
+        # and the saturated-vs-sandbagged verdict, right next to the
+        # cache/fleet/slo lines — absent when roofline=false
+        rf = hb.get("roofline") or {}
+        if isinstance(rf, dict) and rf.get("families"):
+            parts = []
+            for fam, f in sorted(rf["families"].items()):
+                mfu = f.get("mfu")
+                eff = f.get("effective_tflops")
+                verdict = f.get("verdict")
+                if verdict == "host-bound":
+                    verdict = "host-bound (sandbagged)"
+                parts.append(
+                    f"{fam} mfu="
+                    + (f"{100 * mfu:.1f}%" if mfu is not None else "?")
+                    + (f" ({eff} TF)" if eff is not None else "")
+                    + f" {verdict or '?'}")
+            lines.append("    roofline: " + "; ".join(parts))
         fl = hb.get("fleet")
         if isinstance(fl, dict):
             q = fl.get("queue") or {}

@@ -1,0 +1,258 @@
+"""Roofline observatory (telemetry/roofline.py, ISSUE 12).
+
+Pins the MFU-accounting contracts: cost-card capture at the
+DataParallelApply dispatch seam for a jitted toy program, the
+peak-registry / cached-microbench fallback chain, all four verdict
+classifications on synthetic timings, the ``_roofline.json`` schema
+round-trip, the bench-history direction-of-goodness of the new
+efficiency series, and the zero-footprint byte-identity of
+``roofline=false``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.parallel.mesh import DataParallelApply, get_mesh
+from video_features_tpu.telemetry import roofline
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture
+def observer(tmp_path, monkeypatch):
+    """A started observer with a pinned peak, always closed (and the
+    process-global slot cleared) even when the test fails."""
+    monkeypatch.setenv("VFT_ROOFLINE_PEAK", "0.05,10")
+    obs = roofline.RooflineObserver(str(tmp_path), default_family="toy",
+                                    run_id="test")
+    assert obs.start() is obs
+    yield obs
+    obs.close(write=False)
+    assert roofline.active() is None
+
+
+def _toy_runner(n: int = 16) -> DataParallelApply:
+    return DataParallelApply(lambda p, x: x @ p,
+                             np.eye(n, dtype=np.float32),
+                             mesh=get_mesh(n_devices=1))
+
+
+# -- cost cards ---------------------------------------------------------------
+
+def test_cost_card_capture_toy_program(observer, tmp_path):
+    runner = _toy_runner()
+    batch = np.ones((4, 16), np.float32)
+    for _ in range(3):
+        runner(batch)
+    doc = observer.close()
+    fam = doc["families"]["toy"]
+    assert fam["dispatches"] == 3
+    assert len(fam["programs"]) == 1
+    card = fam["programs"][0]
+    assert card["shape"] == [4, 16] and card["dispatches"] == 3
+    # the card's numbers ARE XLA's own cost model for this program
+    direct = roofline.program_cost(runner._fn, runner.params, batch)
+    assert card["flops"] == direct["flops"] > 0
+    assert card["bytes"] == direct["bytes"] > 0
+    assert fam["flops_total"] == pytest.approx(3 * direct["flops"])
+    # forward stage seconds joined in (the profiler-hook chain)
+    assert fam["forward_calls"] == 3 and fam["forward_s"] > 0
+    assert fam["effective_tflops"] is not None
+    assert fam["mfu"] == pytest.approx(
+        fam["effective_tflops"] / 0.05, rel=1e-6)
+    # file landed atomically under the observer's home
+    assert (tmp_path / roofline.ROOFLINE_FILENAME).exists()
+
+
+def test_distinct_shapes_get_distinct_cards(observer):
+    runner = _toy_runner()
+    runner(np.ones((2, 16), np.float32))
+    runner(np.ones((4, 16), np.float32))
+    runner(np.ones((4, 16), np.float32))
+    doc = observer.summary()
+    cards = doc["families"]["toy"]["programs"]
+    assert sorted(tuple(c["shape"]) for c in cards) == [(2, 16), (4, 16)]
+    by_shape = {tuple(c["shape"]): c["dispatches"] for c in cards}
+    assert by_shape == {(2, 16): 1, (4, 16): 2}
+
+
+def test_observe_dispatch_is_noop_when_off():
+    # no active observer: the mesh hook is one global read, never raises
+    assert roofline.active() is None
+    runner = _toy_runner()
+    out = runner(np.ones((4, 16), np.float32))
+    assert out.shape == (4, 16)
+
+
+# -- peak registry + microbench fallback --------------------------------------
+
+def test_peak_registry_known_kinds(monkeypatch):
+    monkeypatch.delenv("VFT_ROOFLINE_PEAK", raising=False)
+    # the v5e calibration from docs/performance.md: practical 127 of
+    # nominal 197, HBM 819 — matched under both spellings
+    for kind in ("TPU v5 lite", "TPU v5e"):
+        peak = roofline.peak_for_device(device_kind=kind, platform="tpu",
+                                        measure=False)
+        assert peak["peak_tflops"] == 127.0
+        assert peak["nominal_tflops"] == 197.0
+        assert peak["peak_gbps"] == 819.0
+        assert peak["source"] == "registry"
+    assert roofline.registry_peak("weird accelerator 9000") is None
+
+
+def test_peak_microbench_fallback_and_cache(tmp_path, monkeypatch):
+    monkeypatch.delenv("VFT_ROOFLINE_PEAK", raising=False)
+    monkeypatch.setenv("VFT_ROOFLINE_CACHE_DIR", str(tmp_path))
+    calls = []
+
+    def fake_measure():
+        calls.append(1)
+        return {"peak_tflops": 0.123, "peak_gbps": 4.56}
+
+    peak = roofline.peak_for_device(device_kind="FPGA mystery",
+                                    platform="cpu",
+                                    measure_fn=fake_measure)
+    assert peak["peak_tflops"] == 0.123 and peak["source"] == "microbench"
+    assert len(calls) == 1
+    assert list(Path(tmp_path).glob("peak_*.json"))
+
+    def exploding_measure():  # second resolve must hit the cache
+        raise AssertionError("microbench re-ran despite a cached peak")
+
+    cached = roofline.peak_for_device(device_kind="FPGA mystery",
+                                      platform="cpu",
+                                      measure_fn=exploding_measure)
+    assert cached["peak_tflops"] == 0.123
+    assert cached["source"] == "microbench (cached)"
+    # measure=False never blocks on a matmul (the heartbeat contract)
+    assert roofline.peak_for_device(device_kind="other unknown",
+                                    platform="cpu", measure=False) is None
+
+
+def test_peak_env_override(monkeypatch):
+    monkeypatch.setenv("VFT_ROOFLINE_PEAK", "127,819")
+    peak = roofline.peak_for_device(device_kind="anything")
+    assert peak["peak_tflops"] == 127.0 and peak["peak_gbps"] == 819.0
+    assert peak["source"] == "env"
+    monkeypatch.setenv("VFT_ROOFLINE_PEAK", "bogus")
+    with pytest.raises(ValueError, match="VFT_ROOFLINE_PEAK"):
+        roofline.peak_for_device(device_kind="anything")
+
+
+def test_measure_peak_small_probe():
+    # a tiny real probe: the numbers must be positive and finite (the
+    # 2048^3 default is the production calibration; n=128 keeps CI fast)
+    m = roofline.measure_peak(n=128, band_elems=1 << 16, calls=2, trials=1)
+    assert m["peak_tflops"] > 0 and np.isfinite(m["peak_tflops"])
+    assert m["peak_gbps"] > 0 and np.isfinite(m["peak_gbps"])
+
+
+# -- the four verdicts --------------------------------------------------------
+
+def test_classify_all_four_verdicts():
+    peak_tf, peak_gb = 100.0, 1000.0  # ridge at 100 FLOP/byte
+    # device idle most of the wall: sandbagged by the host, whatever the
+    # program's intensity
+    assert roofline.classify(1e15, 1e12, forward_s=1.0, wall_s=10.0,
+                             peak_tflops=peak_tf,
+                             peak_gbps=peak_gb) == "host-bound"
+    # device window explained by FLOPs at peak: saturated
+    assert roofline.classify(8e14, 1e11, forward_s=10.0, wall_s=10.0,
+                             peak_tflops=peak_tf,
+                             peak_gbps=peak_gb) == "compute-bound"
+    # below the ridge, window explained by bytes at peak bandwidth
+    assert roofline.classify(1e12, 8e12, forward_s=10.0, wall_s=10.0,
+                             peak_tflops=peak_tf,
+                             peak_gbps=peak_gb) == "bandwidth-bound"
+    # neither FLOPs nor bytes explain the window: fixed per-dispatch cost
+    assert roofline.classify(1e12, 1e11, forward_s=10.0, wall_s=10.0,
+                             peak_tflops=peak_tf,
+                             peak_gbps=peak_gb) == "launch-overhead-bound"
+    # undecidable inputs yield None, never a fabricated verdict
+    assert roofline.classify(0.0, 0.0, 1.0, 1.0, peak_tf, peak_gb) is None
+    assert roofline.classify(1e12, 1e11, 10.0, 10.0, None, None) is None
+
+
+# -- schema round-trip --------------------------------------------------------
+
+def test_roofline_json_schema_roundtrip(observer, tmp_path):
+    runner = _toy_runner()
+    runner(np.ones((4, 16), np.float32))
+    doc = observer.close()
+    path = tmp_path / roofline.ROOFLINE_FILENAME
+    reloaded = json.loads(path.read_text())
+    assert reloaded == json.loads(json.dumps(doc))  # atomic, complete
+    assert roofline.validate_roofline(reloaded) == []
+    assert set(reloaded) == set(roofline.ROOFLINE_FIELDS)
+    fam = reloaded["families"]["toy"]
+    assert set(fam) == set(roofline.FAMILY_FIELDS)
+    assert set(fam["programs"][0]) == set(roofline.CARD_FIELDS)
+    assert fam["verdict"] in roofline.VERDICTS + (None,)
+    # the aggregator reads the same artifact back for vft-roofline
+    agg = roofline.aggregate_rooflines(str(tmp_path))
+    assert agg["families"]["toy"]["dispatches"] == 1
+    assert any("toy" in ln for ln in roofline.render_table(agg))
+
+
+# -- bench-history direction of goodness --------------------------------------
+
+def test_bench_history_efficiency_series():
+    import sys
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "scripts"))
+    import bench_history
+    # mfu/effective_tflops are higher-is-better EVEN on overhead-named
+    # parent rows (the series unit is the field name)
+    assert not bench_history.lower_is_better("x [mfu]", "mfu")
+    assert not bench_history.lower_is_better(
+        "roofline accounting overhead (...) [effective_tflops]",
+        "effective_tflops")
+    assert bench_history.lower_is_better(
+        "roofline accounting overhead (...)", "x wall-clock")
+    rec = {"headline": {"metric": "r21d", "value": 1500.0,
+                        "unit": "clips/sec/chip", "mfu": 0.61,
+                        "effective_tflops": 78.0},
+           "metrics": [{"metric": "s3d row", "value": 160.0,
+                        "unit": "stacks/sec/chip", "mfu": 0.4}]}
+    rows = bench_history._rows(rec)
+    names = {r["metric"]: r for r in rows}
+    assert names["r21d [mfu]"]["value"] == 0.61
+    assert names["r21d [effective_tflops]"]["value"] == 78.0
+    assert names["s3d row [mfu]"]["unit"] == "mfu"
+
+
+# -- zero footprint when off --------------------------------------------------
+
+@pytest.mark.parametrize("order", ["off_first"])
+def test_roofline_off_zero_footprint_byte_identity(tmp_path, sample_video,
+                                                   monkeypatch, order):
+    """roofline=false leaves NO _roofline.json and the features are
+    byte-identical to a roofline=true run — observation must never
+    change what is computed."""
+    from video_features_tpu.cli import main as cli_main
+    monkeypatch.setenv("VFT_ROOFLINE_PEAK", "0.05,10")
+    base = ["feature_type=resnet", "model_name=resnet18", "device=cpu",
+            "allow_random_weights=true", "on_extraction=save_numpy",
+            "batch_size=8", "extraction_total=6", "retry_attempts=1",
+            f"video_paths=[{sample_video}]", f"tmp_path={tmp_path}/tmp"]
+    cli_main(base + [f"output_path={tmp_path}/off", "roofline=false"])
+    cli_main(base + [f"output_path={tmp_path}/on", "roofline=true"])
+    off_dir = tmp_path / "off" / "resnet" / "resnet18"
+    on_dir = tmp_path / "on" / "resnet" / "resnet18"
+    assert not list((tmp_path / "off").rglob("_roofline*.json"))
+    on_doc = json.loads(
+        (on_dir / roofline.ROOFLINE_FILENAME).read_text())
+    assert roofline.validate_roofline(on_doc) == []
+    assert on_doc["families"]["resnet"]["verdict"] in roofline.VERDICTS
+    off_npy = sorted(p.relative_to(off_dir) for p in off_dir.glob("*.npy"))
+    on_npy = sorted(p.relative_to(on_dir) for p in on_dir.glob("*.npy"))
+    assert off_npy == on_npy and off_npy
+    for rel in off_npy:
+        assert (off_dir / rel).read_bytes() == (on_dir / rel).read_bytes()
+    # the off path left the process clean: no dangling observer
+    assert roofline.active() is None

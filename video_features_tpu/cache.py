@@ -76,8 +76,8 @@ NON_SEMANTIC_KEYS = frozenset({
     "video_workers", "decode_workers", "decode_depth", "video_decode",
     "fanout_depth", "cross_video_batching", "clip_batch_size",
     "batch_size", "mesh_devices", "distributed",
-    "telemetry", "metrics_interval_s", "trace", "health", "profile",
-    "profile_trace_dir", "compilation_cache_dir",
+    "telemetry", "metrics_interval_s", "trace", "health", "roofline",
+    "profile", "profile_trace_dir", "compilation_cache_dir",
     "retry_attempts", "retry_backoff_s", "video_deadline_s",
     "retry_failed",
     # fleet scheduling (parallel/queue.py) moves work between hosts; it

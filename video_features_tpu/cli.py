@@ -310,6 +310,22 @@ def main(argv: Optional[List[str]] = None) -> None:
             host_id=(host_id if fleet_mode == "queue"
                      and recorder is not None else None)).start()
 
+    # Roofline observatory (roofline=true, telemetry/roofline.py): XLA
+    # cost cards per dispatched program + measured forward/h2d stage
+    # seconds -> per-family effective TFLOPS, MFU vs the device peak
+    # registry, and a compute/bandwidth/launch-overhead/host-bound
+    # verdict, written to {out_root}/_roofline.json at exit (per-host in
+    # fleet=queue dirs, like traces). Off by default: the dispatch hook
+    # is one module-global read.
+    rf_observer = None
+    if bool(args.get("roofline", False)):
+        from .telemetry.roofline import RooflineObserver
+        rf_observer = RooflineObserver(
+            out_root, default_family=run_label,
+            run_id=(recorder.run_id if recorder is not None else None),
+            host_id=(recorder.host_id if fleet_mode == "queue"
+                     and recorder is not None else None)).start()
+
     # Work-stealing fleet queue (fleet=queue, parallel/queue.py): instead
     # of owning a fixed hash shard, this host claims videos one at a time
     # from the shared {out_root}/_queue/ by atomic rename, renews its
@@ -480,9 +496,23 @@ def main(argv: Optional[List[str]] = None) -> None:
             # close() in the finally: a SIGTERM/KeyboardInterrupt exit must
             # still leave a manifest + final heartbeat behind — that partial
             # record is exactly what an operator debugs the abort with
+            rf_summary = None
+            if rf_observer is not None:
+                try:
+                    # summarized BEFORE the recorder closes so the manifest
+                    # (and the final heartbeat's live snapshot) carry the
+                    # end-of-run MFU/verdicts
+                    rf_summary = rf_observer.summary(resolve_peak=True)
+                except Exception:
+                    rf_summary = None
             recorder.close(tally=dict(tally),
                            wall_s=time.perf_counter() - t_run,
-                           failure_tallies=by_cat)
+                           failure_tallies=by_cat,
+                           roofline=rf_summary)
+        if rf_observer is not None:
+            # after the recorder: observer.close restores the stage hook
+            # only if still its own, and writes _roofline.json atomically
+            rf_observer.close()
         if tracer is not None:
             # likewise in the finally: an aborted run's partial timeline is
             # still a complete, loadable trace file (atomic temp+rename)
@@ -548,6 +578,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"trace: {tracer.trace_path} (render with "
               f"scripts/trace_report.py {out_root}, or open in "
               "https://ui.perfetto.dev)")
+    if rf_observer is not None:
+        print(f"roofline: {rf_observer.path} (render with vft-roofline "
+              f"{out_root})")
     if health_on:
         from .telemetry.health import HEALTH_FILENAME
         print(f"health: per-(video, family) feature digests in "

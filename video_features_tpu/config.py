@@ -333,6 +333,11 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
         raise ValueError(f"health={he!r}: expected true or false (digests "
                          "features into {output_path}/_health.jsonl and "
                          "quarantines NaN/Inf outputs, telemetry/health.py)")
+    rf = args.get("roofline", False)
+    if not isinstance(rf, bool):
+        raise ValueError(f"roofline={rf!r}: expected true or false (MFU "
+                         "accounting into {output_path}/_roofline.json, "
+                         "telemetry/roofline.py — render with vft-roofline)")
 
     # feature-cache keys (cache.py): validated at launch like the
     # telemetry switches — a typo'd cache flag must not silently run cold

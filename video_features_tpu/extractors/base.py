@@ -60,6 +60,11 @@ class BaseExtractor:
         # _extract directly (attach is process-global first-wins, so the
         # double path cannot double-attach).
         self._compile_cache_checked = False
+        # roofline= (telemetry/roofline.py): same lazy library-caller
+        # coverage — the CLI starts the observer itself; a direct
+        # _extract caller gets one homed on output_path, closed (and
+        # _roofline.json written) at interpreter exit
+        self._roofline_checked = False
         # video_decode=process: each video's decode+transform runs in a
         # spawned worker process (utils/io.py ProcessVideoSource) — lifts
         # the parent-GIL ceiling on numpy/PIL transform work on multi-core
@@ -249,6 +254,10 @@ class BaseExtractor:
             self._compile_cache_checked = True
             from ..compile_cache import attach_for_extractor
             attach_for_extractor(self)
+        if not self._roofline_checked:
+            self._roofline_checked = True
+            from ..telemetry.roofline import ensure_for_extractor
+            ensure_for_extractor(self)
         # Precedence: cache hit > filename skip (docs/performance.md).
         # The cache key proves the CONTENT + config + weights match; the
         # filename skip only proves a file with the right name loads —

@@ -316,7 +316,20 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         "capacity_inputs": idle_inputs,
         "families": collect_family_throughput(root),
         "serve": {"hosts": slo_hosts, "totals": slo_totals},
+        # roofline roll-up (telemetry/roofline.py): every host's
+        # _roofline*.json merged — flops/forward sums, MFU recomputed
+        # over the fleet totals, verdict re-derived; None when no host
+        # ran with roofline=true
+        "roofline": _roofline_rollup(root),
     }
+
+
+def _roofline_rollup(root: str) -> Optional[dict]:
+    try:
+        from .telemetry.roofline import aggregate_rooflines
+        return aggregate_rooflines(str(root))
+    except Exception:
+        return None
 
 
 # -- capacity decision plane --------------------------------------------------
@@ -560,6 +573,21 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
             + (f"  dropped={cc['dropped']}" if cc.get("dropped") else "")
             + (f"  entries={','.join(cc['entries'])}"
                if cc.get("entries") else ""))
+    rf = agg.get("roofline")
+    if rf and rf.get("families"):
+        from .telemetry.roofline import render_verdict
+        dev = rf.get("device") or {}
+        parts = []
+        for fam, f in sorted(rf["families"].items()):
+            mfu = f.get("mfu")
+            parts.append(
+                f"{fam} mfu="
+                + (f"{100 * mfu:.1f}%" if mfu is not None else "?")
+                + f" {render_verdict(f.get('verdict'))}")
+        lines.append(
+            f"== roofline ==  peak={dev.get('peak_tflops')} TFLOPS "
+            f"[{dev.get('source')}]  " + "; ".join(parts)
+            + "  (vft-roofline for the full table)")
     if capacity is not None:
         lines += render_capacity(capacity)
     fams = agg["families"]
@@ -645,6 +673,16 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
         g("vft_fleet_capacity_pending_per_host",
           capacity.get("pending_per_host"))
         g("vft_fleet_capacity_idle_share", capacity.get("idle_share"))
+    rf = agg.get("roofline")
+    if rf:
+        for fam, f in (rf.get("families") or {}).items():
+            g("vft_roofline_mfu", f.get("mfu"), family=fam)
+            g("vft_roofline_effective_tflops", f.get("effective_tflops"),
+              family=fam)
+            g("vft_roofline_dispatches_total", f.get("dispatches"),
+              family=fam)
+        g("vft_roofline_peak_tflops",
+          (rf.get("device") or {}).get("peak_tflops"))
     for fam, f in agg["families"].items():
         g("vft_fleet_family_done", f["done"], family=fam)
         g("vft_fleet_family_errors", f["error"], family=fam)

@@ -29,6 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# roofline=true (telemetry/roofline.py): per-program cost-card capture at
+# the dispatch boundary — one module-global read per dispatch when off
+from ..telemetry.roofline import observe_dispatch as _roofline_observe
+
 
 def get_mesh(n_devices: Optional[int] = None,
              axis_names: Tuple[str, ...] = ("data",),
@@ -285,6 +289,7 @@ class DataParallelApply:
         the host-side staging copy + enqueue (a lower bound on wire
         time); on CPU it is the full copy."""
         padded = self._pad(batch_np)
+        _roofline_observe(self, padded)
         if not isinstance(padded, jax.Array):
             from ..utils.profiling import profiler
             with profiler.stage("h2d"):
@@ -297,6 +302,7 @@ class DataParallelApply:
         from ..utils.profiling import profiler
         n = batch_np.shape[0] if n_valid is None else n_valid
         padded = self._pad(batch_np)  # host copy kept out of the timed stage
+        _roofline_observe(self, padded)
         # np.asarray blocks on the device->host copy, so this stage is true
         # H2D + forward + D2H wall time
         with profiler.stage("forward"):

@@ -78,6 +78,7 @@ def build_manifest(*,
                    metrics_dump: Optional[dict] = None,
                    compile_cache: Optional[Dict[str, int]] = None,
                    health: Optional[Dict[str, Dict[str, int]]] = None,
+                   roofline: Optional[dict] = None,
                    ) -> dict:
     done = (tally or {}).get("done", 0)
     return {
@@ -102,6 +103,9 @@ def build_manifest(*,
         # output-health roll-up (telemetry/health.py): per-family digest
         # record + NaN/Inf totals; {} when health=false (nothing observed)
         "health": dict(health or {}),
+        # roofline accounting (telemetry/roofline.py): the run's final
+        # per-family MFU/verdict document; {} when roofline=false
+        "roofline": dict(roofline or {}),
         "config": dict(run_config or {}),
         "versions": _versions(),
         "git": _git_describe(),

@@ -159,9 +159,13 @@ class TelemetryRecorder:
 
     def close(self, *, tally: Optional[Dict[str, int]] = None,
               wall_s: Optional[float] = None,
-              failure_tallies: Optional[Dict[str, int]] = None) -> None:
+              failure_tallies: Optional[Dict[str, int]] = None,
+              roofline: Optional[dict] = None) -> None:
         """Stop the heartbeat thread, write a final heartbeat and the run
-        manifest. Idempotent; never raises into the caller's finally."""
+        manifest. Idempotent; never raises into the caller's finally.
+        ``roofline`` is the run's final MFU-accounting summary
+        (telemetry/roofline.py), passed explicitly by the driver so a
+        later in-process run can never inherit a stale one."""
         if self._closed:
             return
         self._closed = True
@@ -172,7 +176,8 @@ class TelemetryRecorder:
         try:
             self.write_heartbeat(final=True)
             jsonl.write_json_atomic(self.manifest_path, self.build_manifest(
-                tally=tally, wall_s=wall_s, failure_tallies=failure_tallies))
+                tally=tally, wall_s=wall_s, failure_tallies=failure_tallies,
+                roofline=roofline))
         except Exception as e:
             print(f"telemetry: failed to write {self.manifest_path}: "
                   f"{type(e).__name__}: {e}")
@@ -288,6 +293,10 @@ class TelemetryRecorder:
             # and warmth — how vft-fleet proves a joining host skipped
             # its compiles (ISSUE 11)
             "compile_cache": self.compile_cache_snapshot(),
+            # roofline accounting (telemetry/roofline.py): per-family
+            # effective TFLOPS / MFU / verdict, live — {} when
+            # roofline=false, so the off-path heartbeat stays constant
+            "roofline": self.roofline_snapshot(),
         }
         for name, fn in list(self.extra_sections.items()):
             try:
@@ -338,6 +347,17 @@ class TelemetryRecorder:
                        verified=info["verified"], dropped=info["dropped"])
         return out
 
+    def roofline_snapshot(self) -> dict:
+        """The active roofline observer's light per-family summary
+        (telemetry/roofline.py snapshot), ``{}`` when roofline=false —
+        like the compile-cache section, the recorder reads the process-
+        global subsystem rather than owning it."""
+        try:
+            from . import roofline
+            return roofline.snapshot()
+        except Exception:
+            return {}
+
     def fanout_snapshot(self) -> dict:
         """Per-family fan-out backpressure series pulled out of the
         registry: ``{queue_depth, put_blocked_ms_total,
@@ -364,8 +384,8 @@ class TelemetryRecorder:
     # -- manifest ------------------------------------------------------------
     def build_manifest(self, *, tally: Optional[Dict[str, int]] = None,
                        wall_s: Optional[float] = None,
-                       failure_tallies: Optional[Dict[str, int]] = None
-                       ) -> dict:
+                       failure_tallies: Optional[Dict[str, int]] = None,
+                       roofline: Optional[dict] = None) -> dict:
         with self._state_lock:
             tally = dict(tally if tally is not None else self._status_counts)
         stage_totals = {k: {"s": round(v[0], 6), "calls": v[1]}
@@ -390,4 +410,5 @@ class TelemetryRecorder:
                            **{k: v for k, v in
                               (self.compile_cache_snapshot()).items()
                               if k not in ("hits", "misses")}},
+            roofline=roofline,
         )

@@ -741,6 +741,57 @@ def bench_slo_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_alert_overhead(families=("resnet", "clip", "s3d"),
+                         n_copies: int = 2) -> dict:
+    """Wall-clock cost of the alerting & flight-recorder plane (ISSUE
+    13) on the same smoke corpus as the other observability ratios.
+    Both arms run ``telemetry=true`` with a 1s heartbeat so the tick
+    machinery itself is in the baseline; ``on`` adds ``history=true
+    alerts=true`` — per-tick history sampling + compaction accounting
+    AND a full rule-engine evaluation (heartbeat collection, queue
+    counts, history windows) per tick, the quiet-fleet steady state.
+    No rule fires (nothing to capture), so the ratio isolates the
+    always-on cost. Budget <= 1.05x, tracked per round like the
+    trace/health/inject/slo ratios."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the alert bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32", "telemetry=true",
+            "metrics_interval_s=1"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_alert_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_alert{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", [])
+        on = run("on", ["history=true", "alerts=true"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_cache(family: str = "resnet", n_copies: int = 3) -> dict:
     """Repeat-content avoidance ratio (ISSUE 7): the SAME corpus run
     twice with ``cache=true`` into a fresh content-addressed store
@@ -2041,6 +2092,30 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: SLO-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # alerting & flight recorder (ISSUE 13): per-tick history sampling +
+    # a full quiet rule-engine evaluation on the heartbeat cadence — the
+    # sixth always-on observability knob held to the same <= 1.05x
+    # budget, bench-history gated
+    try:
+        ao = bench_alert_overhead()
+        metrics.append({
+            "metric": "alerting + history overhead (alerts=true vs "
+                      f"telemetry-only, {'+'.join(ao['families'])})",
+            "value": ao["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": ao["off_s"],
+            "on_s": ao["on_s"],
+            "note": f"{ao['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs, 1s heartbeat in BOTH arms; on = "
+                    "history sampling + a quiet rule evaluation per tick "
+                    "(no rule fires, nothing captured) — the steady-state "
+                    "watching cost (docs/observability.md 'Alerting & "
+                    "incident bundles')",
+        })
+    except Exception as e:
+        print(f"WARNING: alert-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # repeat-content avoidance (cache.py): second pass over the same
     # corpus must be near-pure cache-hit throughput; tracked per round

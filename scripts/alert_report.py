@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""vft-alert, checkout form: evaluate the alert rules over a fleet root.
+
+Runs the declarative rule engine (telemetry/alerts.py) against a shared
+out_root or vft-serve spool from artifacts alone — one-shot (CI/cron)
+or ``--watch`` continuously next to ``vft-fleet --watch`` — appending
+pending/firing/resolved transitions to ``_alerts.jsonl``, capturing a
+black-box incident bundle under ``_incidents/{alert_id}/`` for every
+firing alert, and exporting Prometheus ``ALERTS``-style gauges with
+``--prom``. ``--fail-on-firing`` makes it a shell-pipeline gate.
+
+Thin wrapper over ``video_features_tpu.telemetry.alerts`` (also
+installed as the ``vft-alert`` console script) so an operator on a bare
+checkout can run ``python scripts/alert_report.py /shared/out`` like
+the other scripts/ tools. See docs/observability.md "Alerting &
+incident bundles".
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.telemetry.alerts import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Alerting quick-gate: emitter and JSON Schema agree, and a real CPU
+smoke trips one rule, captures a verified incident bundle, and resolves.
+
+Fifth sibling of the telemetry/health/trace/roofline gates, for the
+alerting & flight-recorder plane (telemetry/alerts.py). Three halves:
+
+  1. **static**: ``alert.schema.json`` properties == ``ALERT_FIELDS``;
+     ``required`` is a subset; the schema tag / state / severity enums
+     match the module constants; synthetic pending/firing/resolved
+     records validate via the dependency-free validator
+     (telemetry/schema.py).
+  2. **dynamic**: a real resnet CPU smoke with ``alerts=true
+     history=true`` and a deterministic injected ENOSPC
+     (``inject="seed=0;sink.fsync=enospc@n1"``) must fire the
+     ``failure_spike`` rule IN-PROCESS, append schema-valid records,
+     and leave an ``_incidents/{id}/`` bundle whose manifest hashes
+     every captured artifact (``verify_incident``); the
+     ``--fail-on-alert`` gate must trip while firing, and a later
+     ``vft-alert`` one-shot must resolve the episode and lift the gate.
+  3. **false-positive guard**: the same smoke WITHOUT the injected
+     fault must end with zero firing alerts.
+
+Exit 0 = in sync; exit 1 = drift, every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
+
+from video_features_tpu.telemetry import alerts  # noqa: E402
+from video_features_tpu.telemetry.alerts import (ALERT_FIELDS,  # noqa: E402
+                                                 SEVERITIES, STATES,
+                                                 load_alert_schema,
+                                                 validate_alert,
+                                                 verify_incident)
+from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+
+def check_static() -> List[str]:
+    errs: List[str] = []
+    try:
+        sch = load_alert_schema()
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"cannot load {alerts.ALERT_SCHEMA_PATH}: "
+                f"{type(e).__name__}: {e}"]
+    props = set(sch.get("properties", {}))
+    fields = set(ALERT_FIELDS)
+    if props != fields:
+        only_schema = sorted(props - fields)
+        only_emitter = sorted(fields - props)
+        if only_schema:
+            errs.append(f"schema-only properties (emitter never writes "
+                        f"them): {only_schema}")
+        if only_emitter:
+            errs.append(f"emitter fields missing from schema: "
+                        f"{only_emitter}")
+    missing_req = sorted(set(sch.get("required", [])) - props)
+    if missing_req:
+        errs.append(f"required keys not in properties: {missing_req}")
+    tag = sch.get("properties", {}).get("schema", {}).get("enum")
+    if tag != [alerts.SCHEMA_VERSION]:
+        errs.append(f"schema tag enum {tag} != "
+                    f"[{alerts.SCHEMA_VERSION!r}]")
+    if sch.get("properties", {}).get("state", {}).get("enum") != \
+            list(STATES):
+        errs.append("state enum drifted from telemetry/alerts.py STATES")
+    if sch.get("properties", {}).get("severity", {}).get("enum") != \
+            list(SEVERITIES):
+        errs.append("severity enum drifted from SEVERITIES")
+    if sch.get("additionalProperties", True) is not False:
+        errs.append("schema must set additionalProperties: false "
+                    "(the record contract is closed)")
+
+    # synthetic records for every state validate and carry exactly the
+    # declared keys
+    for state in STATES:
+        rec = {"schema": alerts.SCHEMA_VERSION, "alert_id": "r-s-1234",
+               "rule": "synthetic", "severity": "ticket", "state": state,
+               "scope": "host-1", "summary": "synthetic", "value": 1.0,
+               "threshold": 1.0, "since": 1.0, "time": 2.0,
+               "run_id": None, "incident": None}
+        if set(rec) != fields:
+            errs.append(f"synthetic {state} record keys != ALERT_FIELDS")
+        for v in validate_alert(rec):
+            errs.append(f"synthetic {state} record invalid: {v}")
+    return errs
+
+
+def _run_cli(argv: List[str]) -> None:
+    from video_features_tpu.cli import main as cli_main
+    with contextlib.redirect_stdout(sys.stderr):
+        cli_main(argv)
+
+
+def _smoke_argv(out: Path, tmp: Path, extra: List[str]) -> List[str]:
+    return ["feature_type=resnet", "allow_random_weights=true",
+            "on_extraction=save_numpy", f"output_path={out}",
+            f"tmp_path={tmp}", "extraction_fps=2", "batch_size=16",
+            f"video_paths=[{SAMPLE}]", "telemetry=true", "alerts=true",
+            "history=true", "metrics_interval_s=0.3"] + extra
+
+
+def check_dynamic(td: Path) -> List[str]:
+    errs: List[str] = []
+    out = td / "out"
+    try:
+        _run_cli(_smoke_argv(out, td / "tmp", [
+            "retry_attempts=1", "inject=seed=0;sink.fsync=enospc@n1"]))
+    except SystemExit as e:
+        if e.code not in (None, 0):
+            return [f"smoke CLI exited {e.code}"]
+    root = out / "resnet" / "resnet50"
+    recs = list(read_jsonl(root / "_alerts.jsonl"))
+    if not recs:
+        return [f"no alert records in {root}/_alerts.jsonl — the "
+                "injected FATAL did not trip failure_spike in-process"]
+    for rec in recs:
+        for v in validate_alert(rec):
+            errs.append(f"record invalid: {v} in {rec}")
+    firing = [r for r in recs if r["state"] == "firing"
+              and r["rule"] == "failure_spike"]
+    if len(firing) != 1:
+        errs.append(f"expected exactly 1 firing failure_spike record, "
+                    f"got {[(r['rule'], r['state']) for r in recs]}")
+        return errs
+    if not firing[0].get("incident"):
+        errs.append("firing record carries no incident bundle pointer")
+        return errs
+
+    bundle = root / firing[0]["incident"]
+    for v in verify_incident(bundle):
+        errs.append(f"incident bundle: {v}")
+    man = json.loads((bundle / "manifest.json").read_text())
+    paths = [a["path"] for a in man.get("artifacts", [])]
+    for want in ("alert.json",):
+        if want not in paths:
+            errs.append(f"bundle manifest missing {want}")
+    if not any(p.startswith("heartbeats/") for p in paths):
+        errs.append("bundle captured no heartbeats")
+    if not any("_failures" in p for p in paths):
+        errs.append("bundle captured no failure-journal tail")
+    if not any("_history" in p for p in paths):
+        errs.append("bundle captured no history tail")
+
+    # the gate trips while firing...
+    import telemetry_report
+    with contextlib.redirect_stdout(sys.stderr):
+        rc = telemetry_report.main([str(root), "--fail-on-alert"])
+    if rc != 1:
+        errs.append(f"--fail-on-alert returned {rc} while firing "
+                    "(want 1)")
+    # ...and a later one-shot evaluation resolves the episode
+    time.sleep(0.4)
+    with contextlib.redirect_stdout(sys.stderr):
+        rc = alerts.main([str(root), "--window", "0.05",
+                          "--fail-on-firing"])
+    if rc != 0:
+        errs.append(f"vft-alert one-shot returned {rc} after recovery "
+                    "(want 0: the failure aged out of the window)")
+    final = {(r["rule"], r["scope"]): r
+             for r in read_jsonl(root / "_alerts.jsonl")}
+    st = final.get(("failure_spike", firing[0]["scope"]), {}).get("state")
+    if st != "resolved":
+        errs.append(f"episode state after recovery is {st!r} "
+                    "(want 'resolved')")
+    with contextlib.redirect_stdout(sys.stderr):
+        rc = telemetry_report.main([str(root), "--fail-on-alert"])
+    if rc != 0:
+        errs.append(f"--fail-on-alert returned {rc} after resolution "
+                    "(want 0)")
+    return errs
+
+
+def check_quiet(td: Path) -> List[str]:
+    out = td / "quiet"
+    try:
+        _run_cli(_smoke_argv(out, td / "tmp2", []))
+    except SystemExit as e:
+        if e.code not in (None, 0):
+            return [f"quiet smoke CLI exited {e.code}"]
+    root = out / "resnet" / "resnet50"
+    bad = [r for r in read_jsonl(root / "_alerts.jsonl")
+           if r["state"] == "firing"]
+    return [f"healthy run fired {[(r['rule'], r['scope']) for r in bad]} "
+            "— false positive"] if bad else []
+
+
+def main() -> int:
+    errs = [f"static: {e}" for e in check_static()]
+    if errs:
+        # dynamic smoke would only add noise if the contract drifted
+        print("alerts schema gate: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    if not SAMPLE.exists():
+        print("alerts schema gate: PASS (static only — no sample video "
+              "for the smoke)")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_alerts_gate_") as td:
+        errs += [f"smoke: {e}" for e in check_dynamic(Path(td))]
+        errs += [f"quiet: {e}" for e in check_quiet(Path(td))]
+    if errs:
+        print("alerts schema gate: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print("alerts schema gate: PASS (schema == ALERT_FIELDS; injected "
+          "FATAL fired failure_spike in-process with a verified "
+          "incident bundle, --fail-on-alert tripped then lifted, "
+          "one-shot resolution landed; healthy run fired nothing)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -331,6 +331,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "reports SLO violations (serve_slo_s=, "
                          "serve.py) — the CI/canary gate on serving "
                          "latency")
+    ap.add_argument("--fail-on-alert", action="store_true",
+                    help="exit 1 while any alert episode in "
+                         "_alerts.jsonl is firing (prior-run excluded; "
+                         "alerts=true, telemetry/alerts.py) — gate shell "
+                         "pipelines on the run watching itself")
     args = ap.parse_args(argv)
     out = args.output_dir
     if not os.path.isdir(out):
@@ -355,6 +360,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     failure_lines, failure_tallies = render_failures(
         os.path.join(out, "_failures.jsonl"))
     lines += failure_lines
+    # active alert episodes (alerts=true, telemetry/alerts.py):
+    # last-record-wins off _alerts.jsonl, prior-run excluded like the
+    # heartbeats above
+    from video_features_tpu.telemetry.alerts import (current_alerts,
+                                                     render_alerts)
+    active_alerts = current_alerts(
+        out, started_time=(man or {}).get("started_time"))
+    lines += render_alerts(active_alerts)
     print("\n".join(lines))
 
     if args.prom:
@@ -378,6 +391,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                   + ", ".join(f"{h}: {v} violation(s)"
                               for h, v in sorted(slo_bad.items())),
                   file=sys.stderr)
+            return 1
+    if args.fail_on_alert:
+        firing = [a for a in active_alerts if a.get("state") == "firing"]
+        if firing:
+            print("fail-on-alert: "
+                  + ", ".join(f"{a['rule']}({a['scope']}): {a['summary']}"
+                              for a in firing), file=sys.stderr)
             return 1
     return 0
 

@@ -1,0 +1,580 @@
+"""Alerting & flight-recorder plane (ISSUE 13, telemetry/alerts.py +
+telemetry/history.py): retained heartbeat series with tiered
+downsampling, the declarative rule engine's pending→firing→resolved
+state machine (journal-as-state, dedup), every built-in rule on
+synthetic observations, incident-bundle completeness, the report-tool
+gates, and the CLI E2E acceptance loop — an injected fault run
+deterministically fires an alert, captures a schema-valid bundle, and
+resolves after recovery; ``alerts=false`` stays byte-identical.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.telemetry import alerts, history
+from video_features_tpu.telemetry.alerts import (ALERT_FIELDS, AlertConfig,
+                                                 AlertEngine, AlertRule,
+                                                 current_alerts,
+                                                 load_alert_schema,
+                                                 validate_alert,
+                                                 verify_incident)
+from video_features_tpu.telemetry.jsonl import read_jsonl, write_json_atomic
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+NOW = 1_700_000_000.0
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _obs(root, now=NOW, hosts=(), queue=None, claims=None,
+         claims_tracked=False, hist=None):
+    return {"root": str(root), "time": now, "hosts": list(hosts),
+            "n_live": sum(1 for e in hosts if e.get("state") == "live"),
+            "queue": queue, "claims": claims or {},
+            "claims_tracked": claims_tracked, "history": hist or {}}
+
+
+def _host(host_id, state="live", age=1.0, prior=False, fleet=None):
+    hb = {"host_id": host_id, "run_id": "r", "time": NOW - age,
+          "interval_s": 2.0, "final": state == "FINISHED"}
+    if fleet is not None:
+        hb["fleet"] = fleet
+    return {"path": f"_heartbeat_{host_id}.json", "dir": ".", "hb": hb,
+            "state": state, "age_s": age, "prior_run": prior}
+
+
+def _samples(host="h1", n=10, dt=30.0, t0=NOW - 9 * 30.0, **series):
+    """n history samples ending at NOW; each kwarg is a dotted-path leaf
+    given as a list of n values (e.g. slo_requests=[...])."""
+    out = []
+    for i in range(n):
+        s = {"schema": history.SAMPLE_SCHEMA, "time": t0 + i * dt,
+             "host_id": host, "run_id": "r", "uptime_s": i * dt,
+             "final": False,
+             "videos": {"done": i, "skipped": 0, "error": 0,
+                        "quarantined": 0}}
+        for key, vals in series.items():
+            path = key.split("__")
+            cur = s
+            for part in path[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[path[-1]] = vals[i]
+        out.append(s)
+    return out
+
+
+def _rule_flag(flag):
+    """A test rule that fires while ``flag['on']`` is truthy."""
+    def ev(obs, cfg):
+        if flag.get("on"):
+            return [{"scope": "s1", "summary": "synthetic condition",
+                     "value": 1.0, "threshold": 1.0}]
+        return []
+    return ev
+
+
+# -- schema ------------------------------------------------------------------
+
+def test_alert_schema_pins_emitter_fields():
+    sch = load_alert_schema()
+    assert set(sch["properties"]) == set(ALERT_FIELDS)
+    assert set(sch["required"]) <= set(sch["properties"])
+    assert sch["additionalProperties"] is False
+    assert sch["properties"]["schema"]["enum"] == [alerts.SCHEMA_VERSION]
+    assert sch["properties"]["state"]["enum"] == list(alerts.STATES)
+    assert sch["properties"]["severity"]["enum"] == list(alerts.SEVERITIES)
+
+
+# -- the state machine -------------------------------------------------------
+
+def test_pending_dwell_then_firing_then_resolved(tmp_path):
+    flag = {"on": True}
+    rule = AlertRule("synthetic", "ticket", "test", _rule_flag(flag),
+                     for_s=10.0)
+    eng = AlertEngine(tmp_path, rules=(rule,), capture_incidents=False)
+    obs = _obs(tmp_path)
+    r1 = eng.evaluate(obs=obs, now=NOW)
+    assert [r["state"] for r in r1] == ["pending"]
+    assert not validate_alert(r1[0])
+    # dwell not yet elapsed: no transition, no record
+    assert eng.evaluate(obs=obs, now=NOW + 5) == []
+    r2 = eng.evaluate(obs=obs, now=NOW + 11)
+    assert [r["state"] for r in r2] == ["firing"]
+    assert r2[0]["alert_id"] == r1[0]["alert_id"]  # one episode
+    assert r2[0]["since"] == r1[0]["since"]
+    # steady firing: dedup — nothing emitted
+    assert eng.evaluate(obs=obs, now=NOW + 20) == []
+    flag["on"] = False
+    r3 = eng.evaluate(obs=obs, now=NOW + 30)
+    assert [r["state"] for r in r3] == ["resolved"]
+    assert r3[0]["alert_id"] == r1[0]["alert_id"]
+    assert current_alerts(tmp_path) == []
+
+
+def test_pending_that_clears_resolves_without_firing(tmp_path):
+    flag = {"on": True}
+    rule = AlertRule("synthetic", "ticket", "test", _rule_flag(flag),
+                     for_s=60.0)
+    eng = AlertEngine(tmp_path, rules=(rule,), capture_incidents=False)
+    eng.evaluate(obs=_obs(tmp_path), now=NOW)
+    flag["on"] = False
+    r = eng.evaluate(obs=_obs(tmp_path), now=NOW + 5)
+    assert [x["state"] for x in r] == ["resolved"]
+    states = [x["state"] for x in read_jsonl(tmp_path / "_alerts.jsonl")]
+    assert states == ["pending", "resolved"]  # never fired
+
+
+def test_journal_is_the_state_across_engine_instances(tmp_path):
+    """A cron one-shot (fresh engine) adopts and resolves an episode a
+    long-dead evaluator fired — the journal is the state."""
+    flag = {"on": True}
+    rule = AlertRule("synthetic", "page", "test", _rule_flag(flag))
+    e1 = AlertEngine(tmp_path, rules=(rule,), capture_incidents=False)
+    fired = e1.evaluate(obs=_obs(tmp_path), now=NOW)
+    assert [r["state"] for r in fired] == ["firing"]
+    flag["on"] = False
+    e2 = AlertEngine(tmp_path, rules=(rule,), capture_incidents=False)
+    resolved = e2.evaluate(obs=_obs(tmp_path), now=NOW + 60)
+    assert [r["state"] for r in resolved] == ["resolved"]
+    assert resolved[0]["alert_id"] == fired[0]["alert_id"]
+
+
+def test_clear_dwell_holds_firing_in_one_engine(tmp_path):
+    flag = {"on": True}
+    rule = AlertRule("synthetic", "ticket", "test", _rule_flag(flag),
+                     clear_for_s=30.0)
+    eng = AlertEngine(tmp_path, rules=(rule,), capture_incidents=False)
+    eng.evaluate(obs=_obs(tmp_path), now=NOW)
+    flag["on"] = False
+    assert eng.evaluate(obs=_obs(tmp_path), now=NOW + 10) == []  # dwell
+    flag["on"] = True  # condition back: dwell resets, still firing
+    assert eng.evaluate(obs=_obs(tmp_path), now=NOW + 20) == []
+    flag["on"] = False
+    assert eng.evaluate(obs=_obs(tmp_path), now=NOW + 25) == []
+    r = eng.evaluate(obs=_obs(tmp_path), now=NOW + 60)
+    assert [x["state"] for x in r] == ["resolved"]
+
+
+# -- built-in rules ----------------------------------------------------------
+
+def test_slo_burn_fires_only_when_both_windows_burn(tmp_path):
+    cfg = AlertConfig(short_window_s=300, long_window_s=3600)
+    # 13 samples over 1h: no violations until the last 5 min, where 10
+    # of 10 requests violate -> short window burns hard, but the hour
+    # window holds 10/130 ≈ 7.7% > 5% budget -> burn_l ≈ 1.5: fires
+    n = 13
+    req = [10 * i for i in range(n)]
+    vio = [0] * (n - 1) + [10]
+    hist = {"h1": _samples(n=n, dt=300.0, t0=NOW - (n - 1) * 300.0,
+                           slo__requests=req, slo__violations=vio)}
+    found = alerts._rule_slo_burn(_obs(tmp_path, hist=hist), cfg)
+    assert len(found) == 1 and found[0]["scope"] == "h1"
+    assert found[0]["value"] >= cfg.burn_threshold
+    # same short burst against a long window that already absorbed it:
+    # 10 violations an hour ago, none since -> short window clean
+    vio2 = [10] * n
+    hist2 = {"h1": _samples(n=n, dt=300.0, t0=NOW - (n - 1) * 300.0,
+                            slo__requests=req, slo__violations=vio2)}
+    assert alerts._rule_slo_burn(_obs(tmp_path, hist=hist2), cfg) == []
+
+
+def test_slo_burn_quiet_service_never_fires(tmp_path):
+    hist = {"h1": _samples(slo__requests=[5 * i for i in range(10)],
+                           slo__violations=[0] * 10)}
+    assert alerts._rule_slo_burn(_obs(tmp_path, hist=hist),
+                                 AlertConfig()) == []
+
+
+def test_host_stalled_scopes_to_held_leases(tmp_path):
+    """With claim tracking, a stalled host alerts only while its leases
+    are outstanding — the episode resolves when siblings reclaim them
+    (the only resolution path a SIGKILLed host ever gets)."""
+    claimed = tmp_path / "_queue" / "claimed" / "dead-1"
+    claimed.mkdir(parents=True)
+    (claimed / "item.json").write_text("{}")
+    obs = _obs(tmp_path, hosts=[_host("dead-1", "STALLED", age=120.0)],
+               claims={"dead-1": 1}, claims_tracked=True)
+    found = alerts._rule_host_stalled(obs, AlertConfig())
+    assert len(found) == 1 and "claim" in found[0]["summary"]
+    # leases reclaimed -> condition clear even though still STALLED
+    obs2 = _obs(tmp_path, hosts=[_host("dead-1", "STALLED", age=200.0)],
+                claims={}, claims_tracked=True)
+    assert alerts._rule_host_stalled(obs2, AlertConfig()) == []
+    # plain batch host (no claim tracking): staleness alone fires
+    obs3 = _obs(tmp_path, hosts=[_host("b1", "STALLED", age=120.0)])
+    assert len(alerts._rule_host_stalled(obs3, AlertConfig())) == 1
+    # live / finished / prior-run hosts never fire
+    for h in (_host("a", "live"), _host("b", "FINISHED"),
+              _host("c", "STALLED", prior=True)):
+        assert alerts._rule_host_stalled(_obs(tmp_path, hosts=[h]),
+                                         AlertConfig()) == []
+
+
+def test_queue_growth_needs_depth_and_no_drain(tmp_path):
+    cfg = AlertConfig()
+    grow = {"h1": _samples(
+        fleet__queue__pending=[2, 4, 6, 8, 10, 12, 14, 16, 18, 20])}
+    obs = _obs(tmp_path, hosts=[_host("h1")],
+               queue={"pending": 20}, hist=grow)
+    assert len(alerts._rule_queue_growth(obs, cfg)) == 1
+    # deep but draining: no alert
+    drain = {"h1": _samples(
+        fleet__queue__pending=[40, 36, 32, 28, 24, 22, 21, 20, 20, 20])}
+    obs = _obs(tmp_path, hosts=[_host("h1")],
+               queue={"pending": 20}, hist=drain)
+    assert alerts._rule_queue_growth(obs, cfg) == []
+    # shallow: no alert regardless of slope
+    obs = _obs(tmp_path, hosts=[_host("h1")],
+               queue={"pending": 1}, hist=grow)
+    assert alerts._rule_queue_growth(obs, cfg) == []
+
+
+def test_spike_rules_fire_on_windowed_increase(tmp_path):
+    cfg = AlertConfig()
+    hist = {"h1": _samples(
+        fleet__reclaimed=[0, 0, 0, 0, 0, 1, 2, 3, 3, 3],
+        fleet__queue__quarantined=[0, 0, 0, 0, 0, 0, 0, 0, 0, 1],
+        nonfinite_total=[0, 0, 0, 0, 0, 0, 0, 0, 2, 2])}
+    obs = _obs(tmp_path, hist=hist)
+    assert len(alerts._rule_reclaim_spike(obs, cfg)) == 1
+    assert len(alerts._rule_quarantine_spike(obs, cfg)) == 1
+    nf = alerts._rule_nonfinite(obs, cfg)
+    assert len(nf) == 1 and "non-finite" in nf[0]["summary"]
+    quiet = {"h1": _samples(fleet__reclaimed=[2] * 10,
+                            nonfinite_total=[3] * 10)}
+    obs = _obs(tmp_path, hist=quiet)
+    assert alerts._rule_reclaim_spike(obs, cfg) == []
+    assert alerts._rule_nonfinite(obs, cfg) == []
+
+
+def test_failure_spike_counts_error_and_quarantine(tmp_path):
+    hist = {"h1": _samples()}
+    for i, s in enumerate(hist["h1"]):
+        s["videos"]["error"] = 0 if i < 8 else 1
+    assert len(alerts._rule_failure_spike(_obs(tmp_path, hist=hist),
+                                          AlertConfig())) == 1
+    flat = {"h1": _samples()}
+    assert alerts._rule_failure_spike(_obs(tmp_path, hist=flat),
+                                     AlertConfig()) == []
+
+
+def test_cache_collapse_needs_warm_baseline(tmp_path):
+    # window = one 30s-sample step, so the cold tail IS the window
+    cfg = AlertConfig(cache_min_lookups=10, spike_window_s=40)
+    # warm run (~80% cumulative) whose last two steps go fully cold
+    hits = [0, 90, 180, 270, 360, 450, 540, 630, 632, 634]
+    miss = [0, 10, 20, 30, 40, 50, 60, 70, 108, 146]
+    warm_cold = {"h1": _samples(cache__hits=hits, cache__misses=miss)}
+    found = alerts._rule_cache_collapse(_obs(tmp_path, hist=warm_cold),
+                                        cfg)
+    assert len(found) == 1
+    # never-warm run: identical cold window, no baseline to defend
+    cold = {"h1": _samples(cache__hits=[0] * 10,
+                           cache__misses=[20 * i for i in range(10)])}
+    assert alerts._rule_cache_collapse(_obs(tmp_path, hist=cold),
+                                       cfg) == []
+
+
+def test_mfu_regression_vs_own_history(tmp_path):
+    vals = [0.60, 0.61, 0.59, 0.62, 0.60, 0.61, 0.60, 0.59, 0.61, 0.30]
+    hist = {"h1": _samples(mfu__r21d=vals)}
+    found = alerts._rule_mfu_regression(_obs(tmp_path, hist=hist),
+                                        AlertConfig())
+    assert len(found) == 1 and found[0]["scope"] == "h1/r21d"
+    steady = {"h1": _samples(mfu__r21d=[0.6] * 10)}
+    assert alerts._rule_mfu_regression(_obs(tmp_path, hist=steady),
+                                       AlertConfig()) == []
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def _stale_root(tmp_path):
+    root = tmp_path / "out"
+    root.mkdir()
+    write_json_atomic(root / "_heartbeat_hostA.json",
+                      {"run_id": "r1", "host_id": "hostA",
+                       "time": NOW - 100, "interval_s": 2.0,
+                       "final": False})
+    (root / "_failures.jsonl").write_text(
+        json.dumps({"video": "v.mp4", "category": "FATAL"}) + "\n")
+    (root / "_telemetry.jsonl").write_text(
+        json.dumps({"video": "v.mp4", "status": "error"}) + "\n")
+    return root
+
+
+def test_incident_bundle_complete_and_tamper_evident(tmp_path):
+    root = _stale_root(tmp_path)
+    eng = AlertEngine(root, clock=lambda: NOW)
+    fired = [r for r in eng.evaluate(now=NOW) if r["state"] == "firing"]
+    assert len(fired) == 1 and fired[0]["rule"] == "host_stalled"
+    bundle = root / fired[0]["incident"]
+    man = json.loads((bundle / "manifest.json").read_text())
+    paths = [a["path"] for a in man["artifacts"]]
+    assert "alert.json" in paths
+    assert any(p.startswith("heartbeats/") for p in paths)
+    assert any("_failures" in p for p in paths)
+    assert any("_telemetry" in p for p in paths)
+    assert verify_incident(bundle) == []
+    # every listed artifact is hashed: tampering is detected
+    victim = bundle / paths[-1]
+    victim.write_text(victim.read_text() + "x")
+    assert any("mismatch" in e for e in verify_incident(bundle))
+    # and a missing manifest is a hard violation, not a pass
+    (bundle / "manifest.json").unlink()
+    assert verify_incident(bundle)
+
+
+def test_bundle_snapshots_never_reingested(tmp_path):
+    """Captured heartbeat/journal copies must not resurrect as live
+    artifacts in any collector — a bundle is inert evidence."""
+    from video_features_tpu import fleet_report
+    root = _stale_root(tmp_path)
+    eng = AlertEngine(root, clock=lambda: NOW)
+    eng.evaluate(now=NOW)
+    entries = fleet_report.collect_heartbeats(str(root), now=NOW)
+    assert len(entries) == 1  # the real one, not the bundle copy
+    assert history.read_history(str(root)) == {}
+    fams = fleet_report.collect_family_throughput(str(root))
+    assert sum(f["records"] for f in fams.values()) == 1
+
+
+def test_capture_failure_degrades_to_alert_without_bundle(tmp_path):
+    root = _stale_root(tmp_path)
+    blocked = root / alerts.INCIDENTS_DIRNAME
+    blocked.write_text("not a directory")  # makedirs will fail
+    eng = AlertEngine(root, clock=lambda: NOW)
+    fired = [r for r in eng.evaluate(now=NOW) if r["state"] == "firing"]
+    assert len(fired) == 1 and fired[0]["incident"] is None
+
+
+# -- history retention -------------------------------------------------------
+
+def test_sample_from_heartbeat_fields():
+    hb = {"time": NOW, "host_id": "h", "run_id": "r", "uptime_s": 9.0,
+          "final": False, "videos": {"done": 3, "error": 1},
+          "videos_done": 4, "videos_per_s": 0.4,
+          "cache": {"hits": {"resnet": 5}, "misses": {"resnet": 2},
+                    "bypasses": {}},
+          "compile_cache": {"hits": 7, "misses": 0},
+          "fleet": {"active_claims": 1, "stolen": 0, "reclaimed": 2,
+                    "quarantined": 0, "idle_wait_s_total": 1.5,
+                    "queue": {"pending": 4, "claimed": 1, "done": 2,
+                              "quarantined": 0}},
+          "serve": {"pending": 2,
+                    "slo": {"slo_s": 1.0, "requests": 10,
+                            "violations": 3}},
+          "roofline": {"families": {"r21d": {"mfu": 0.61}}}}
+    s = history.sample_from_heartbeat(hb, nonfinite_total=2)
+    assert s["schema"] == history.SAMPLE_SCHEMA
+    assert s["videos"] == {"done": 3, "skipped": 0, "error": 1,
+                           "quarantined": 0}
+    assert s["cache"] == {"hits": 5, "misses": 2, "bypasses": 0}
+    assert s["compile_cache"] == {"hits": 7, "misses": 0}
+    assert s["fleet"]["queue"]["pending"] == 4
+    assert s["slo"] == {"slo_s": 1.0, "requests": 10, "violations": 3}
+    assert s["mfu"] == {"r21d": 0.61}
+    assert s["nonfinite_total"] == 2
+    json.dumps(s)  # JSON-safe by construction
+
+
+def test_downsample_tiers_bound_a_week_of_ticks():
+    # a week of 2s ticks = 302400 samples
+    t0 = NOW - 7 * 86400.0
+    samples = [{"time": t0 + i * 2.0} for i in range(302400)]
+    kept = history.downsample(samples, now=NOW)
+    # ~300 full-res + 120 + 288 + 336 -> comfortably bounded
+    assert len(kept) < 1200
+    times = [s["time"] for s in kept]
+    assert times == sorted(times)
+    # the newest 10 minutes keep full resolution
+    recent = [t for t in times if NOW - t <= 600.0]
+    assert len(recent) >= 295
+    # nothing older than the last tier survives
+    assert min(times) >= NOW - 7 * 86400.0 - 1800.0
+
+
+def test_history_writer_appends_and_compacts(tmp_path):
+    w = history.HistoryWriter(tmp_path, "hostX", clock=lambda: NOW)
+    old = NOW - 2 * 86400.0  # mid: one per 5 min tier
+    for i in range(20):
+        w.observe({"schema": history.SAMPLE_SCHEMA, "host_id": "hostX",
+                   "time": NOW - 8 * 86400.0 + i})  # past the last tier
+    for i in range(10):
+        w.observe({"schema": history.SAMPLE_SCHEMA, "host_id": "hostX",
+                   "time": NOW - i})
+    kept = w.compact()
+    assert kept == 10  # week-old samples dropped, fresh kept whole
+    assert len(history.read_history(str(tmp_path))["hostX"]) == 10
+    assert old  # silence lint
+
+
+def test_window_delta_partial_window_and_reset_guard():
+    samples = [{"time": NOW - 60 + i * 10, "videos": {"error": i}}
+               for i in range(7)]
+    # full window
+    d = history.window_delta(samples, "videos.error", NOW, 30.0)
+    assert d is not None and d[0] == 3 and abs(d[1] - 30.0) < 1e-6
+    # window wider than the series: the oldest sample is the baseline
+    d = history.window_delta(samples, "videos.error", NOW, 9999.0)
+    assert d is not None and d[0] == 6
+    # counter reset (a new run reused the dir): a negative delta is
+    # None, never a spike — and gauges opt in to signed deltas
+    reset = samples + [{"time": NOW + 10, "videos": {"error": 0}}]
+    assert history.window_delta(reset, "videos.error", NOW + 10,
+                                30.0) is None
+    d = history.window_delta(reset, "videos.error", NOW + 10, 30.0,
+                             allow_negative=True)
+    assert d is not None and d[0] < 0
+    # fewer than two samples with the field: no window
+    assert history.window_delta(samples[:1], "videos.error", NOW,
+                                30.0) is None
+    assert history.window_delta(samples, "videos.nope", NOW, 30.0) is None
+
+
+# -- rendering / prom / gates ------------------------------------------------
+
+def test_render_and_prom_series(tmp_path):
+    root = _stale_root(tmp_path)
+    AlertEngine(root, clock=lambda: NOW).evaluate(now=NOW)
+    active = current_alerts(root)
+    lines = alerts.render_alerts(active)
+    assert lines and "1 firing" in lines[0]
+    assert any("host_stalled(hostA)" in ln for ln in lines)
+    series = alerts.alerts_prom_series(active)
+    assert len(series) == 1
+    assert series[0]["name"] == "ALERTS"
+    assert series[0]["labels"]["alertname"] == "host_stalled"
+    assert series[0]["labels"]["alertstate"] == "firing"
+    from video_features_tpu.telemetry.metrics import prometheus_text
+    text = prometheus_text({"series": series})
+    assert 'ALERTS{alertname="host_stalled"' in text
+
+
+def test_fleet_report_renders_and_gates_on_alerts(tmp_path, capsys):
+    from video_features_tpu import fleet_report
+    root = _stale_root(tmp_path)
+    AlertEngine(root, clock=lambda: NOW).evaluate(now=NOW)
+    agg = fleet_report.aggregate(str(root))
+    assert [a["rule"] for a in agg["alerts"]] == ["host_stalled"]
+    assert any("== alerts ==" in ln for ln in fleet_report.render(agg))
+    dump = fleet_report.build_prom_dump(agg)
+    assert any(s["name"] == "ALERTS" for s in dump["series"])
+    assert fleet_report.main([str(root), "--fail-on-alert"]) == 1
+    capsys.readouterr()
+    # resolve (fresh heartbeat), re-evaluate: the gate lifts
+    write_json_atomic(root / "_heartbeat_hostA.json",
+                      {"run_id": "r1", "host_id": "hostA",
+                       "time": time.time(), "interval_s": 2.0,
+                       "final": False})
+    AlertEngine(root).evaluate()
+    assert fleet_report.main([str(root), "--fail-on-alert"]) == 0
+
+
+def test_telemetry_report_fail_on_alert_excludes_prior_run(tmp_path,
+                                                           capsys):
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    import telemetry_report
+    root = _stale_root(tmp_path)
+    AlertEngine(root, clock=lambda: NOW).evaluate(now=NOW)
+    assert telemetry_report.main([str(root), "--fail-on-alert"]) == 1
+    out = capsys.readouterr()
+    assert "host_stalled" in out.out + out.err
+    # a NEWER run in the same dir: the stale firing record is that
+    # prior run's business — excluded, gate lifts
+    write_json_atomic(root / "_run.json",
+                      {"run_id": "r2", "started_time": NOW + 50})
+    assert telemetry_report.main([str(root), "--fail-on-alert"]) == 0
+
+
+# -- CLI E2E: the acceptance loop --------------------------------------------
+
+def _run_cli(argv):
+    from video_features_tpu.cli import main as cli_main
+    cli_main(argv)
+
+
+def _base_argv(out, tmp, extra=()):
+    return ["feature_type=resnet", "allow_random_weights=true",
+            "on_extraction=save_numpy", f"output_path={out}",
+            f"tmp_path={tmp}", "extraction_fps=2", "batch_size=16",
+            f"video_paths=[{SAMPLE}]"] + list(extra)
+
+
+def test_cli_inject_fires_bundles_and_resolves(tmp_path):
+    """ISSUE 13 acceptance: an injected fault run deterministically
+    raises a firing alert, writes a schema-valid ``_alerts.jsonl``
+    record and a complete incident bundle, and the alert resolves
+    after recovery (a later one-shot evaluation)."""
+    out = tmp_path / "out"
+    _run_cli(_base_argv(out, tmp_path / "tmp", [
+        "telemetry=true", "alerts=true", "history=true",
+        "metrics_interval_s=0.3", "retry_attempts=1",
+        "inject=seed=0;sink.fsync=enospc@n1"]))
+    root = out / "resnet" / "resnet50"
+    recs = list(read_jsonl(root / "_alerts.jsonl"))
+    assert recs, "no alert records"
+    for r in recs:
+        assert validate_alert(r) == []
+    firing = [r for r in recs if r["state"] == "firing"
+              and r["rule"] == "failure_spike"]
+    assert len(firing) == 1
+    assert firing[0]["run_id"] is not None
+    bundle = root / firing[0]["incident"]
+    assert verify_incident(bundle) == []
+    paths = [a["path"] for a in json.loads(
+        (bundle / "manifest.json").read_text())["artifacts"]]
+    assert any("_failures" in p for p in paths)  # the journal evidence
+    assert any(p.startswith("heartbeats/") for p in paths)
+    # retained history exists and carries the failure counter
+    series = history.read_history(str(root))
+    assert len(series) == 1
+    (host, samples), = series.items()
+    assert samples[-1]["videos"]["error"] == 1
+    # recovery: the failure ages out of a (shrunken) window -> resolved
+    time.sleep(0.3)
+    assert alerts.main([str(root), "--window", "0.05"]) == 0
+    final = {(r["rule"], r["scope"]): r
+             for r in read_jsonl(root / "_alerts.jsonl")}
+    assert final[("failure_spike", host)]["state"] == "resolved"
+    assert current_alerts(root) == []
+    # and the resolved record still points at the bundle
+    assert final[("failure_spike", host)]["incident"] == \
+        firing[0]["incident"]
+
+
+def test_alerts_off_is_byte_identical_and_footprint_free(tmp_path):
+    """``alerts=false`` (the default) must leave features AND the
+    telemetry artifact set byte-identical to pre-alerting behavior: no
+    journal, no history, no incidents, no heartbeat section."""
+    out_off = tmp_path / "off"
+    out_on = tmp_path / "on"
+    _run_cli(_base_argv(out_off, tmp_path / "t1",
+                        ["telemetry=true", "metrics_interval_s=60"]))
+    _run_cli(_base_argv(out_on, tmp_path / "t2",
+                        ["telemetry=true", "metrics_interval_s=60",
+                         "alerts=true", "history=true"]))
+    root_off = out_off / "resnet" / "resnet50"
+    root_on = out_on / "resnet" / "resnet50"
+    a = np.load(root_off / "v_synth_sample_resnet.npy")
+    b = np.load(root_on / "v_synth_sample_resnet.npy")
+    assert a.tobytes() == b.tobytes()
+    # the off run has zero alerting footprint
+    assert not (root_off / "_alerts.jsonl").exists()
+    assert not (root_off / alerts.INCIDENTS_DIRNAME).exists()
+    assert list(root_off.glob("_history_*.jsonl")) == []
+    hb_off, = root_off.glob("_heartbeat_*.json")
+    assert "alerts" not in json.loads(hb_off.read_text())
+    # the on run retained history and published the heartbeat section
+    assert list(root_on.glob("_history_*.jsonl"))
+    hb_on, = root_on.glob("_heartbeat_*.json")
+    assert "alerts" in json.loads(hb_on.read_text())

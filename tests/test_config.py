@@ -210,6 +210,26 @@ def test_corr_lookup_config_promotion(monkeypatch, tmp_path):
         sanity_check(load_config("raft", {**base, "fuse_convc1": "yes"}))
 
 
+def test_history_alerts_key_validation(tmp_path):
+    """history=/alerts= (ISSUE 13, telemetry/history.py +
+    telemetry/alerts.py): booleans validated at launch, and both
+    require telemetry=true — samples and rule evaluation ride the
+    heartbeat cadence, so enabling them without a recorder would
+    silently watch nothing."""
+    base = dict(video_paths="a.mp4", output_path=str(tmp_path / "o"),
+                tmp_path=str(tmp_path / "t"))
+    cfg = load_config("resnet", {**base, "telemetry": True,
+                                 "history": True, "alerts": True})
+    sanity_check(cfg)  # must not raise
+    for bad in ({"history": "yes"}, {"alerts": "on"}):
+        with pytest.raises(ValueError):
+            sanity_check(load_config("resnet", {**base,
+                                                "telemetry": True, **bad}))
+    for flag in ("history", "alerts"):
+        with pytest.raises(ValueError, match="telemetry=true"):
+            sanity_check(load_config("resnet", {**base, flag: True}))
+
+
 def test_fleet_key_validation(tmp_path):
     """fleet= scheduling keys (parallel/queue.py): a typo'd mode or a
     queue run missing its prerequisites must fail at launch, before N

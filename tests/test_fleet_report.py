@@ -375,3 +375,56 @@ def test_planner_idle_share_uses_window_delta():
                   now=NOW + 25)
     assert r["idle_share"] == 0.9
     assert r["recommendation"] == "scale_down"
+
+
+# -- planner persistence (ISSUE 13 satellite: recommendations must
+# survive vft-fleet restarts) ------------------------------------------------
+
+def test_planner_state_survives_restart(tmp_path):
+    """Streak, cooldown and the slope baseline persist at the root: a
+    relaunched watcher continues the hysteresis instead of resetting
+    it (previously the planner lived only across one process's --watch
+    passes, fleet_report.py:904)."""
+    root = str(tmp_path)
+    p1 = fleet_report.CapacityPlanner.for_root(root, confirm_ticks=2,
+                                               cooldown_s=300.0)
+    r1 = p1.observe(_agg(live=2, pending=10), now=NOW)
+    assert r1["recommendation"] == "hold" and r1["streak"] == 1
+    assert (tmp_path / fleet_report.CapacityPlanner.STATE_FILENAME).exists()
+    # restart: a FRESH planner confirms on its first observation,
+    # because the streak persisted
+    p2 = fleet_report.CapacityPlanner.for_root(root, confirm_ticks=2,
+                                               cooldown_s=300.0)
+    r2 = p2.observe(_agg(live=2, pending=10), now=NOW + 2)
+    assert r2["recommendation"] == "scale_up" and r2["changed"]
+    # restart again: the cooldown pin ALSO survives — the queue drains
+    # but the fresh watcher must not flip mid-cooldown
+    p3 = fleet_report.CapacityPlanner.for_root(root, confirm_ticks=1,
+                                               cooldown_s=300.0)
+    r3 = p3.observe(_agg(live=2, pending=0, claimed=0, idle_s=90.0,
+                         uptime_s=100.0), now=NOW + 10)
+    assert r3["recommendation"] == "scale_up"
+    assert any("cooldown" in x for x in r3["reasons"])
+
+
+def test_planner_seeds_slope_baseline_from_history(tmp_path):
+    """With no state file yet, the slope inputs re-point at the
+    retained history series (telemetry/history.py): the first
+    observation of a brand-new watcher already has a real window."""
+    from video_features_tpu.telemetry.history import (SAMPLE_SCHEMA,
+                                                      HistoryWriter)
+    w = HistoryWriter(tmp_path, "h1")
+    w.observe({"schema": SAMPLE_SCHEMA, "time": NOW - 60.0,
+               "host_id": "h1", "uptime_s": 100.0,
+               "fleet": {"idle_wait_s_total": 10.0},
+               "slo": {"requests": 100, "violations": 10}})
+    p = fleet_report.CapacityPlanner.for_root(str(tmp_path),
+                                              confirm_ticks=1,
+                                              cooldown_s=0.0)
+    assert p._prev is not None
+    assert p._prev["attainment_pct"] == 90.0
+    # first observation: attainment recovered 90 -> 93 over the minute,
+    # slope is positive -> NOT a scale-up even while below target
+    r = p.observe(_agg(live=2, attainment=93.0, requests=120), now=NOW)
+    assert r["attainment_slope_pct_per_min"] == pytest.approx(3.0)
+    assert r["pressure"] == "hold"

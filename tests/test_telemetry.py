@@ -92,6 +92,63 @@ def test_prometheus_text_roundtrips_through_json():
     assert "# TYPE vft_stage_seconds histogram" in text
 
 
+# -- histogram_quantile edges (ISSUE 13 satellite: burn-rate math must
+# not return misleading values on sparse windows) ---------------------------
+
+def test_histogram_quantile_empty_snapshot_is_none():
+    from video_features_tpu.telemetry.metrics import (Histogram,
+                                                      histogram_quantile,
+                                                      histogram_quantiles)
+    h = Histogram("h", (), buckets=(0.1, 1.0))
+    snap = h.snapshot()
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert histogram_quantile(snap, q) is None
+    assert histogram_quantiles(snap) == {"p50": None, "p95": None,
+                                         "p99": None}
+    # a count without buckets (torn/foreign snapshot) is also None,
+    # never a crash or a fabricated latency
+    assert histogram_quantile({"count": 5, "buckets": []}, 0.5) is None
+    assert histogram_quantile({}, 0.5) is None
+
+
+def test_histogram_quantile_single_bucket_interpolates():
+    from video_features_tpu.telemetry.metrics import (Histogram,
+                                                      histogram_quantile)
+    h = Histogram("h", (), buckets=(1.0,))
+    for _ in range(4):
+        h.observe(0.5)
+    snap = h.snapshot()
+    # rank interpolates linearly inside the lone [0, 1.0] bucket
+    assert histogram_quantile(snap, 0.5) == pytest.approx(0.5)
+    assert histogram_quantile(snap, 1.0) == pytest.approx(1.0)
+    # q is clamped into [0, 1], and q=0 anchors at the bucket floor
+    assert histogram_quantile(snap, -3.0) == pytest.approx(0.0)
+    assert histogram_quantile(snap, 7.0) == pytest.approx(1.0)
+
+
+def test_histogram_quantile_past_last_bucket_clamps():
+    from video_features_tpu.telemetry.metrics import (Histogram,
+                                                      histogram_quantile)
+    h = Histogram("h", (), buckets=(0.1, 1.0))
+    # every observation lands in the implicit +Inf bucket
+    for _ in range(5):
+        h.observe(50.0)
+    snap = h.snapshot()
+    assert snap["inf_count"] == 5 and snap["count"] == 5
+    # the estimate clamps to the largest finite bound — a conservative
+    # floor, never a fabricated tail
+    assert histogram_quantile(snap, 0.99) == pytest.approx(1.0)
+    # mixed: the quantile past the finite mass also clamps
+    h2 = Histogram("h2", (), buckets=(0.1, 1.0))
+    h2.observe(0.05)
+    h2.observe(0.05)
+    h2.observe(50.0)
+    h2.observe(50.0)
+    snap2 = h2.snapshot()
+    assert histogram_quantile(snap2, 0.99) == pytest.approx(1.0)
+    assert histogram_quantile(snap2, 0.25) == pytest.approx(0.05)
+
+
 # -- StageProfiler drain (satellite: snapshot/reset race) -------------------
 
 def test_drain_returns_and_clears_atomically():

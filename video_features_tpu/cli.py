@@ -291,7 +291,27 @@ def main(argv: Optional[List[str]] = None) -> None:
             feature_type=run_label,
             interval_s=float(args.get("metrics_interval_s") or 30.0),
             host_id=host_id,
-        ).start()
+        )
+
+    # Alerting & flight recorder (alerts=true) + retained heartbeat
+    # history (history=true): both ride the heartbeat tick as recorder
+    # hooks, registered BEFORE start() so the t=0 heartbeat seeds the
+    # windowed baselines. alerts=true implies history retention — the
+    # burn-rate/spike rules diff retained samples. A firing rule appends
+    # a transition to {out_root}/_alerts.jsonl and captures a black-box
+    # bundle under _incidents/{alert_id}/ (telemetry/alerts.py;
+    # docs/observability.md "Alerting & incident bundles").
+    alert_engine = None
+    if recorder is not None:
+        if bool(args.get("history", False)) or bool(args.get("alerts",
+                                                             False)):
+            from .telemetry.history import HistoryWriter
+            HistoryWriter(out_root, recorder.host_id).attach(recorder)
+        if bool(args.get("alerts", False)):
+            from .telemetry.alerts import AlertEngine
+            alert_engine = AlertEngine(
+                out_root, run_id=recorder.run_id).attach(recorder)
+        recorder.start()
 
     # Pipeline tracing (trace=true): a Chrome-trace timeline of the host
     # pipeline — every profiler.stage call, fan-out backpressure stall,
@@ -574,6 +594,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"telemetry: {recorder.manifest_path} + {recorder.spans_path} "
               f"(render with scripts/telemetry_report.py "
               f"{out_root})")
+    if alert_engine is not None:
+        s = alert_engine.heartbeat_section()
+        print(f"alerts: {s.get('firing', 0)} firing / "
+              f"{s.get('pending', 0)} pending at exit — journal in "
+              f"{out_root}/_alerts.jsonl, incident bundles in "
+              f"{out_root}/_incidents/ (render with vft-alert {out_root})")
     if tracer is not None:
         print(f"trace: {tracer.trace_path} (render with "
               f"scripts/trace_report.py {out_root}, or open in "

@@ -338,6 +338,23 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
         raise ValueError(f"roofline={rf!r}: expected true or false (MFU "
                          "accounting into {output_path}/_roofline.json, "
                          "telemetry/roofline.py — render with vft-roofline)")
+    hi = args.get("history", False)
+    if not isinstance(hi, bool):
+        raise ValueError(f"history={hi!r}: expected true or false (retained "
+                         "heartbeat samples in {output_path}/"
+                         "_history_{host_id}.jsonl, telemetry/history.py)")
+    al = args.get("alerts", False)
+    if not isinstance(al, bool):
+        raise ValueError(f"alerts={al!r}: expected true or false (alert "
+                         "rules on the heartbeat cadence into "
+                         "{output_path}/_alerts.jsonl + _incidents/ "
+                         "bundles, telemetry/alerts.py — render with "
+                         "vft-alert)")
+    if (hi or al) and not args.get("telemetry", False):
+        raise ValueError(
+            "history=true / alerts=true need telemetry=true: samples and "
+            "rule evaluation ride the heartbeat cadence "
+            "(docs/observability.md 'Alerting & incident bundles')")
 
     # feature-cache keys (cache.py): validated at launch like the
     # telemetry switches — a typo'd cache flag must not silently run cold

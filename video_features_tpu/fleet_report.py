@@ -70,6 +70,15 @@ STITCH_SCHEMA = "vft.trace_fleet/1"
 #: a distinct pid so Perfetto renders one process group per host
 STITCH_PID_BASE = 1000
 
+#: flight-recorder bundles (telemetry/alerts.py) hold frozen COPIES of
+#: heartbeats/journals/traces; every artifact collector below must skip
+#: this subtree or captured snapshots resurrect as ghost hosts
+INCIDENTS_DIRNAME = "_incidents"
+
+
+def _in_incident(p: Path) -> bool:
+    return INCIDENTS_DIRNAME in p.parts
+
 
 def _load_json(path: str) -> Optional[dict]:
     try:
@@ -129,7 +138,8 @@ def collect_heartbeats(root: str, now: Optional[float] = None) -> List[dict]:
     out: List[dict] = []
     seen: set = set()
     root_p = Path(root)
-    paths = sorted(root_p.rglob(HEARTBEAT_GLOB))
+    paths = [p for p in sorted(root_p.rglob(HEARTBEAT_GLOB))
+             if not _in_incident(p)]
     # rglob misses nothing below, but the root itself may BE a file list
     for p in paths:
         rp = str(p.resolve())
@@ -167,6 +177,8 @@ def collect_family_throughput(root: str) -> Dict[str, dict]:
     see."""
     fams: Dict[str, dict] = {}
     for path in sorted(Path(root).rglob(SPANS_FILENAME)):
+        if _in_incident(path):
+            continue
         for rec in read_jsonl(path):
             fam = str(rec.get("feature_type") or "?")
             f = fams.setdefault(fam, {"records": 0, "done": 0, "error": 0,
@@ -224,6 +236,36 @@ def _queue_counts(root: str, entries: List[dict]) -> Optional[dict]:
         return None
     return dict(((best.get("hb") or {}).get("fleet") or {})
                 .get("queue") or {})
+
+
+def _newest_started_time(root: str) -> Optional[float]:
+    """The freshest manifest's ``started_time`` under the root — the
+    prior-run cutoff for alert gating (an alert whose last transition
+    predates every current run is a previous run's business)."""
+    best: Optional[float] = None
+    for p in sorted(Path(str(root)).rglob(MANIFEST_FILENAME)):
+        if _in_incident(p):
+            continue
+        man = _load_json(str(p))
+        st = (man or {}).get("started_time")
+        try:
+            if st is not None:
+                best = float(st) if best is None else max(best, float(st))
+        except (TypeError, ValueError):
+            continue
+    return best
+
+
+def collect_alerts(root: str) -> List[dict]:
+    """Active (pending/firing) alert episodes off ``_alerts.jsonl``,
+    prior-run excluded against the newest sibling manifest
+    (telemetry/alerts.py owns the journal contract)."""
+    try:
+        from .telemetry.alerts import current_alerts
+        return current_alerts(str(root),
+                              started_time=_newest_started_time(root))
+    except Exception:
+        return []
 
 
 def aggregate(root: str, now: Optional[float] = None) -> dict:
@@ -316,6 +358,10 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         "capacity_inputs": idle_inputs,
         "families": collect_family_throughput(root),
         "serve": {"hosts": slo_hosts, "totals": slo_totals},
+        # active alert episodes (telemetry/alerts.py): rendered, prom'd
+        # as ALERTS gauges and gated by --fail-on-alert; evaluation
+        # itself belongs to the in-process engines and vft-alert
+        "alerts": collect_alerts(root),
         # roofline roll-up (telemetry/roofline.py): every host's
         # _roofline*.json merged — flops/forward sums, MFU recomputed
         # over the fleet totals, verdict re-derived; None when no host
@@ -357,27 +403,114 @@ class CapacityPlanner:
     recommendation changes it is pinned for ``cooldown_s`` (scaling
     actions take time to land; re-deciding mid-flight oscillates).
     Thresholds and the clock are injectable for tests.
+
+    **Persistence**: with a ``state_path`` (or via :meth:`for_root`) the
+    streak/cooldown/slope state survives ``vft-fleet`` restarts —
+    without it, every restart reset the hysteresis and a freshly
+    relaunched watcher could re-recommend a scale action the previous
+    one had just cooled down from. When no state file exists yet, the
+    slope baseline seeds from the retained heartbeat history
+    (telemetry/history.py), so even the FIRST observation of a new
+    watcher has a real window behind it.
     """
 
     #: recommendation -> prometheus gauge value
     SCALE = {"scale_up": 1, "hold": 0, "scale_down": -1}
 
+    STATE_FILENAME = "_capacity_state.json"
+    STATE_SCHEMA = "vft.capacity_state/1"
+
     def __init__(self, *, slo_target_pct: float = 95.0,
                  up_pending_per_host: float = 2.0,
                  down_idle_share: float = 0.5,
                  confirm_ticks: int = 2, cooldown_s: float = 120.0,
-                 clock=time.time) -> None:
+                 clock=time.time,
+                 state_path: Optional[str] = None) -> None:
         self.slo_target_pct = float(slo_target_pct)
         self.up_pending_per_host = float(up_pending_per_host)
         self.down_idle_share = float(down_idle_share)
         self.confirm_ticks = max(1, int(confirm_ticks))
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
+        self.state_path = state_path
         self._prev: Optional[dict] = None  # last observation's raw inputs
         self._want: Optional[str] = None
         self._streak = 0
         self._recommendation = "hold"
         self._last_change: Optional[float] = None
+        if state_path is not None:
+            self._load_state()
+
+    @classmethod
+    def for_root(cls, root: str, **kw) -> "CapacityPlanner":
+        """A planner keyed on the fleet root: state in
+        ``{root}/_capacity_state.json``, slope baseline seeded from the
+        root's retained history when no state file exists yet."""
+        p = cls(state_path=os.path.join(str(root), cls.STATE_FILENAME),
+                **kw)
+        if p._prev is None:
+            p._seed_prev_from_history(str(root))
+        return p
+
+    # -- persistence --------------------------------------------------------
+    def _load_state(self) -> None:
+        st = _load_json(str(self.state_path))
+        if st is None or st.get("schema") != self.STATE_SCHEMA:
+            return
+        self._want = st.get("want")
+        self._streak = int(st.get("streak") or 0)
+        self._recommendation = str(st.get("recommendation") or "hold")
+        lc = st.get("last_change")
+        self._last_change = float(lc) if lc is not None else None
+        prev = st.get("prev")
+        self._prev = dict(prev) if isinstance(prev, dict) else None
+
+    def _save_state(self) -> None:
+        if self.state_path is None:
+            return
+        from .telemetry.jsonl import write_json_atomic
+        try:
+            write_json_atomic(str(self.state_path), {
+                "schema": self.STATE_SCHEMA,
+                "want": self._want,
+                "streak": self._streak,
+                "recommendation": self._recommendation,
+                "last_change": self._last_change,
+                "prev": self._prev,
+            })
+        except OSError as e:
+            print(f"vft-fleet: cannot persist capacity state to "
+                  f"{self.state_path}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    def _seed_prev_from_history(self, root: str) -> None:
+        """Baseline the idle/attainment slopes from the newest retained
+        sample per host (telemetry/history.py) — real data instead of a
+        null first window."""
+        from .telemetry.history import read_history
+        series = read_history(root)
+        if not series:
+            return
+        idle = up = req = vio = 0.0
+        t_max = None
+        for samples in series.values():
+            s = samples[-1]
+            t = float(s.get("time") or 0.0)
+            t_max = t if t_max is None else max(t_max, t)
+            fl = s.get("fleet") or {}
+            idle += float(fl.get("idle_wait_s_total") or 0.0)
+            up += float(s.get("uptime_s") or 0.0)
+            slo = s.get("slo") or {}
+            req += float(slo.get("requests") or 0)
+            vio += float(slo.get("violations") or 0)
+        if t_max is None:
+            return
+        self._prev = {
+            "idle_wait_s_total": idle, "uptime_s": up,
+            "attainment_pct": (round(100.0 * (req - vio) / req, 2)
+                               if req else None),
+            "time": t_max,
+        }
 
     # -- signal derivation --------------------------------------------------
     def _signals(self, agg: dict, now: float) -> dict:
@@ -483,6 +616,7 @@ class CapacityPlanner:
         self._prev = {"idle_wait_s_total": s["idle_wait_s_total"],
                       "uptime_s": s["uptime_s"],
                       "attainment_pct": s["attainment_pct"], "time": now}
+        self._save_state()
         out = {"recommendation": self._recommendation,
                "pressure": want, "streak": self._streak,
                "changed": flipped, "reasons": reasons}
@@ -549,6 +683,9 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
         if str(hb.get("host_id")) in agg["stragglers"]:
             line += "  STRAGGLER (fleet idle behind this host)"
         lines.append(line)
+    if agg.get("alerts"):
+        from .telemetry.alerts import render_alerts
+        lines += render_alerts(agg["alerts"])
     if agg["queue"] is not None:
         q = agg["queue"]
         lines.append(
@@ -697,6 +834,13 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
         for p in ("p50", "p95", "p99"):
             g("vft_fleet_serve_service_seconds", svc.get(p),
               host_id=h["host_id"], quantile=p)
+    if agg.get("alerts"):
+        # ALERTS{alertname, alertstate, severity, scope} 1 — the exact
+        # series shape Prometheus-native alert evaluators export, so
+        # existing Alertmanager routing consumes the fleet's alerts with
+        # zero translation (telemetry/alerts.py)
+        from .telemetry.alerts import alerts_prom_series
+        series.extend(alerts_prom_series(agg["alerts"]))
     return {"series": series}
 
 
@@ -708,7 +852,7 @@ def find_trace_files(root: str) -> List[Path]:
     fleet workers and serve siblings write — excluding stitched/merged
     OUTPUT files, which must never feed back in as inputs."""
     return [p for p in sorted(Path(root).rglob("_trace*.json"))
-            if p.name not in TRACE_OUTPUT_NAMES]
+            if p.name not in TRACE_OUTPUT_NAMES and not _in_incident(p)]
 
 
 def _host_label(doc: dict, trace_dir: str) -> str:
@@ -826,6 +970,8 @@ def find_request(root: str, request_id: str) -> List[str]:
     for name, kind in ((SPANS_FILENAME, "span"), (HEALTH_FILENAME,
                        "health"), (FAILURES_FILENAME, "failure")):
         for path in sorted(root_p.rglob(name)):
+            if _in_incident(path):
+                continue
             for rec in read_jsonl(path):
                 if rec.get("request_id") == rid or rec.get("id") == rid:
                     tail = (f"status={rec.get('status')}" if kind == "span"
@@ -885,6 +1031,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--request", metavar="ID", default=None,
                     help="print every artifact record one request id "
                          "produced, fleet-wide")
+    ap.add_argument("--fail-on-alert", action="store_true",
+                    help="exit 1 while any alert episode is firing "
+                         "(prior-run excluded) — the fleet-level twin of "
+                         "telemetry_report's gate (telemetry/alerts.py)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.root):
         print(f"error: {args.root} is not a directory", file=sys.stderr)
@@ -901,11 +1051,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  {h}")
         return 0
 
-    # capacity decision plane: one planner across every --watch pass, so
-    # the hysteresis/slope state observes the fleet over real time (a
-    # one-shot report still gets the instantaneous pressure verdict)
-    planner = CapacityPlanner()
+    # capacity decision plane: one planner across every --watch pass,
+    # PERSISTED at the root (`_capacity_state.json`) so hysteresis,
+    # cooldown and the slope baseline survive watcher restarts — and
+    # seeded from the retained history series when starting fresh
+    planner = CapacityPlanner.for_root(args.root)
     capacity = None
+    agg = None
     passes = 0
     while True:
         agg = aggregate(args.root)
@@ -946,6 +1098,14 @@ def main(argv: Optional[List[str]] = None) -> int:
               + ("wall-clock aligned" if other.get("aligned")
                  else "UNALIGNED — unanchored traces present")
               + ") — open in https://ui.perfetto.dev")
+    if args.fail_on_alert:
+        firing = [a for a in (agg or {}).get("alerts") or []
+                  if a.get("state") == "firing"]
+        if firing:
+            print("fail-on-alert: "
+                  + ", ".join(f"{a['rule']}({a['scope']})"
+                              for a in firing), file=sys.stderr)
+            return 1
     return 0
 
 

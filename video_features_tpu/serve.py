@@ -318,6 +318,23 @@ class ServeLoop:
             host_id=host_id)
         self.recorder.extra_sections["serve"] = self._serve_section
 
+        # retained history + alerting, homed on the spool like the
+        # heartbeat (telemetry/history.py, telemetry/alerts.py): the SLO
+        # burn-rate rule diffs this server's own retained
+        # requests/violations counters on every tick, so a burn pages
+        # without any external watcher. Registered before run() calls
+        # recorder.start() — the t=0 heartbeat seeds the windows.
+        self.alert_engine = None
+        if bool(args.get("history", False)) or bool(args.get("alerts",
+                                                             False)):
+            from .telemetry.history import HistoryWriter
+            HistoryWriter(self.spool_dir, host_id).attach(self.recorder)
+        if bool(args.get("alerts", False)):
+            from .telemetry.alerts import AlertEngine
+            self.alert_engine = AlertEngine(
+                self.spool_dir,
+                run_id=self.recorder.run_id).attach(self.recorder)
+
         # pipeline tracing (trace=true): the Chrome-trace recorder homed
         # on the SPOOL dir like the heartbeat, so `serve.request` /
         # `video_attempt` windows (each stamped with its request id) land

@@ -143,6 +143,13 @@ class TelemetryRecorder:
         # heartbeat file IS the serve liveness protocol, so the recorder
         # stays the single writer (one atomic replace per tick)
         self.extra_sections: Dict[str, Callable[[], dict]] = {}
+        # post-write hooks: called with the heartbeat dict just written
+        # (telemetry/history.py appends its retained sample here,
+        # telemetry/alerts.py evaluates its rules) — register them
+        # BEFORE start() so the t=0 heartbeat is observed too, which is
+        # what gives short runs a windowed baseline at all
+        self.tick_hooks: List[Callable[[dict], None]] = []
+        self._tick_hook_errors = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "TelemetryRecorder":
@@ -378,8 +385,19 @@ class TelemetryRecorder:
         return out
 
     def write_heartbeat(self, final: bool = False) -> None:
-        jsonl.write_json_atomic(self.heartbeat_path,
-                                self.build_heartbeat(final=final))
+        hb = self.build_heartbeat(final=final)
+        jsonl.write_json_atomic(self.heartbeat_path, hb)
+        for fn in list(self.tick_hooks):
+            try:
+                fn(hb)
+            except Exception as e:
+                # hooks observe; they must never break liveness — but a
+                # silently-dead retention/alerting channel is its own
+                # incident, so the first failure is named
+                self._tick_hook_errors += 1
+                if self._tick_hook_errors == 1:
+                    print(f"telemetry: heartbeat hook failed: "
+                          f"{type(e).__name__}: {e}")
 
     # -- manifest ------------------------------------------------------------
     def build_manifest(self, *, tally: Optional[Dict[str, int]] = None,

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Gateway quick-gate: the network front door's overload drill, end to
+end over real HTTP (ISSUE 14).
+
+Sibling of the ``check_*_smoke.py`` gates, for the `vft-gateway`
+ingress (gateway.py) fronting a real 1-worker ``ServeLoop`` backend:
+
+  1. **two tenants, one over-quota**: tenant ``starved`` (rate 0.5/s,
+     burst 1) fires a rapid burst — exactly one 202, the rest explicit
+     ``429 + Retry-After``; honoring the Retry-After and retrying later
+     SUCCEEDS (the shed is a fast no, not a ban);
+  2. **the in-quota tenant is isolated from the overload**: tenant
+     ``paying`` (high priority, generous quota) submits during the
+     burst and completes with ``slo_violated: false`` against the
+     configured ``serve_slo_s``;
+  3. **bounded spool**: while the burst runs, the spool's ``requests/``
+     depth never exceeds ``gateway_spool_bound`` — admission backs
+     pressure up to the HTTP edge instead of growing a directory;
+  4. **bit-identical to spool-direct**: the gateway-ingested upload's
+     features are byte-identical to the same bytes extracted through a
+     plain spool-direct request (the HTTP hop adds nothing and loses
+     nothing);
+  5. **audit PASS**: the whole tree (spool + outputs + gateway journal)
+     passes ``vft-audit --expect-complete`` — per-tenant journal counts
+     reconcile with the spool's terminal markers.
+
+Exit 0 = contract holds; exit 1 = every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml); the in-suite twins are
+tests/test_gateway.py (admission/deadline units) and tests/test_chaos.py
+(the gateway chaos seeds).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+TENANTS = """
+tenants:
+  paying:
+    key: paying-k
+    rate_rps: 50
+    burst: 50
+    max_inflight: 8
+    priority: high
+  starved:
+    key: starved-k
+    rate_rps: 0.5
+    burst: 1
+    max_inflight: 2
+    priority: low
+"""
+
+BURST = 6
+SPOOL_BOUND = 2
+
+
+def _call(base, method, path, data=None, key=None):
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if key:
+        req.add_header("X-API-Key", key)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def check_gateway(td: Path) -> List[str]:
+    from video_features_tpu import serve
+    from video_features_tpu.audit import audit_run
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.gateway import GatewayServer
+
+    errs: List[str] = []
+    spool = td / "spool"
+    (td / "tenants.yml").write_text(TENANTS)
+
+    cfg = load_config("resnet", {
+        "model_name": "resnet18", "device": "cpu",
+        "allow_random_weights": True, "on_extraction": "save_numpy",
+        "extraction_total": 6, "batch_size": 8, "cache": True,
+        "cache_dir": str(td / "cache"), "spool_dir": str(spool),
+        "serve_poll_interval_s": 0.05, "metrics_interval_s": 1,
+        "serve_slo_s": 120.0, "serve_workers": 1,
+        "output_path": str(td / "out"), "tmp_path": str(td / "tmp")})
+    sanity_check(cfg, require_videos=False)
+    loop = serve.ServeLoop(cfg, out_root=str(td / "out"))
+    t = threading.Thread(target=loop.run, daemon=True)
+    t.start()
+    gw = GatewayServer({"spool_dir": str(spool),
+                        "gateway_tenants": str(td / "tenants.yml"),
+                        "gateway_spool_bound": SPOOL_BOUND,
+                        "gateway_poll_interval_s": 0.05,
+                        "metrics_interval_s": 1}).start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        # gateway-ingested content (tenant `paying` uploads once)
+        data = SAMPLE.read_bytes()
+        st, up, _ = _call(base, "POST", "/v1/upload?name=clip.mp4", data,
+                          key="paying-k")
+        if st != 201:
+            errs.append(f"upload failed: {st} {up}")
+            return errs
+
+        # ---- 1+3. over-quota burst: 429s with Retry-After, spool
+        # depth bounded the whole time -------------------------------
+        results, max_pending = [], 0
+        extract = json.dumps({"video_paths": [up["path"]],
+                              "timeout_s": 240}).encode()
+        for _ in range(BURST):
+            results.append(_call(base, "POST", "/v1/extract", extract,
+                                 key="starved-k"))
+            max_pending = max(max_pending, gw._spool_pending())
+        codes = [r[0] for r in results]
+        if codes.count(202) != 1 or codes.count(429) != BURST - 1:
+            errs.append(f"burst of {BURST} over burst=1 must yield "
+                        f"exactly one 202 and {BURST - 1} 429s, got "
+                        f"{codes}")
+        retry_after = None
+        for st, body, hdrs in results:
+            if st == 429:
+                if "Retry-After" not in hdrs:
+                    errs.append(f"429 without Retry-After: {body}")
+                else:
+                    retry_after = int(hdrs["Retry-After"])
+
+        # ---- 2. the in-quota tenant rides through the overload ------
+        st, acc, _ = _call(base, "POST", "/v1/extract", extract,
+                           key="paying-k")
+        if st != 202:
+            errs.append(f"in-quota tenant refused during burst: "
+                        f"{st} {acc}")
+        else:
+            resp = serve.wait_response(str(spool), acc["id"],
+                                       timeout_s=240)
+            if resp.get("status") != "done":
+                errs.append(f"in-quota request did not complete: {resp}")
+            elif resp.get("slo_violated"):
+                errs.append(f"in-quota tenant violated the SLO during "
+                            f"the burst: {resp}")
+
+        # drain the starved tenant's one accepted request too
+        for st, body, _h in results:
+            if st == 202:
+                serve.wait_response(str(spool), body["id"], timeout_s=240)
+        if max_pending > SPOOL_BOUND:
+            errs.append(f"spool pending hit {max_pending} > "
+                        f"gateway_spool_bound={SPOOL_BOUND} — admission "
+                        "must bound the backlog")
+
+        # ---- 1b. honoring Retry-After makes the retry succeed -------
+        time.sleep((retry_after or 2) + 0.5)
+        st, body, _ = _call(base, "POST", "/v1/extract", extract,
+                            key="starved-k")
+        if st != 202:
+            errs.append(f"retry after Retry-After still refused: "
+                        f"{st} {body}")
+        else:
+            resp = serve.wait_response(str(spool), body["id"],
+                                       timeout_s=240)
+            if resp.get("status") != "done":
+                errs.append(f"post-backoff retry did not complete: "
+                            f"{resp}")
+
+        # ---- 4. bit-identical to a spool-direct request -------------
+        direct_vid = td / "direct_clip.mp4"
+        shutil.copy(SAMPLE, direct_vid)
+        rid = serve.submit_request(str(spool), [str(direct_vid)])
+        resp = serve.wait_response(str(spool), rid, timeout_s=240)
+        if resp.get("status") != "done":
+            errs.append(f"spool-direct request failed: {resp}")
+        out = td / "out"
+        gw_npys = sorted(out.rglob(f"{Path(up['path']).stem}_resnet.npy"))
+        direct_npys = sorted(out.rglob("direct_clip_resnet.npy"))
+        if not gw_npys or not direct_npys:
+            errs.append(f"missing artifacts: gw={gw_npys} "
+                        f"direct={direct_npys}")
+        elif gw_npys[0].read_bytes() != direct_npys[0].read_bytes():
+            errs.append("gateway-ingested features differ from the "
+                        "spool-direct extraction of identical bytes")
+    finally:
+        gw.stop()
+        loop.stop()
+        t.join(timeout=240)
+
+    # ---- 5. the whole tree audits clean ------------------------------
+    ok, violations, _notes = audit_run(str(td), cache_dir=str(td / "cache"),
+                                       expect_complete=True)
+    if not ok:
+        errs.append("vft-audit FAILED the gateway run:\n    "
+                    + "\n    ".join(violations))
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"SKIP: vendored sample missing ({SAMPLE})")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_gateway_smoke_") as td:
+        errs = check_gateway(Path(td))
+    if errs:
+        print("GATEWAY SMOKE: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"GATEWAY SMOKE: OK (burst of {BURST} -> 1 accepted + "
+          f"{BURST - 1} fast 429s, Retry-After honored, in-quota tenant "
+          "inside SLO, spool bounded, features bit-identical to "
+          "spool-direct, audit PASS)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

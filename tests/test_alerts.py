@@ -188,6 +188,33 @@ def test_slo_burn_fires_only_when_both_windows_burn(tmp_path):
     assert alerts._rule_slo_burn(_obs(tmp_path, hist=hist2), cfg) == []
 
 
+def test_slo_burn_scopes_per_tenant(tmp_path):
+    """Per-tenant burn (ISSUE 14): one tenant burning ITS budget pages
+    as `{host}/tenant={name}`, while a healthy co-tenant (and a healthy
+    host-level aggregate) stays silent."""
+    cfg = AlertConfig(short_window_s=300, long_window_s=3600)
+    n = 13
+    # host aggregate: 260 requests, only the noisy tenant's 10
+    # violations — host-level burn over the hour stays under budget
+    # while tenant `noisy` burns 10/10 in the short window
+    req = [20 * i for i in range(n)]
+    vio = [0] * (n - 1) + [10]
+    quiet = [10 * i for i in range(n)]
+    zeros = [0] * n
+    hist = {"h1": _samples(
+        n=n, dt=300.0, t0=NOW - (n - 1) * 300.0,
+        slo__requests=req, slo__violations=zeros,
+        tenants__noisy__requests=[10 * i for i in range(n - 1)] + [130],
+        tenants__noisy__violations=vio,
+        tenants__calm__requests=quiet,
+        tenants__calm__violations=zeros)}
+    found = alerts._rule_slo_burn(_obs(tmp_path, hist=hist), cfg)
+    assert len(found) == 1, found
+    assert found[0]["scope"] == "h1/tenant=noisy"
+    assert "tenant noisy" in found[0]["summary"]
+    assert found[0]["value"] >= cfg.burn_threshold
+
+
 def test_slo_burn_quiet_service_never_fires(tmp_path):
     hist = {"h1": _samples(slo__requests=[5 * i for i in range(10)],
                            slo__violations=[0] * 10)}
@@ -372,7 +399,9 @@ def test_sample_from_heartbeat_fields():
                               "quarantined": 0}},
           "serve": {"pending": 2,
                     "slo": {"slo_s": 1.0, "requests": 10,
-                            "violations": 3}},
+                            "violations": 3},
+                    "tenants": {"alpha": {"requests": 7, "violations": 1,
+                                          "rejects": 2}}},
           "roofline": {"families": {"r21d": {"mfu": 0.61}}}}
     s = history.sample_from_heartbeat(hb, nonfinite_total=2)
     assert s["schema"] == history.SAMPLE_SCHEMA
@@ -382,6 +411,9 @@ def test_sample_from_heartbeat_fields():
     assert s["compile_cache"] == {"hits": 7, "misses": 0}
     assert s["fleet"]["queue"]["pending"] == 4
     assert s["slo"] == {"slo_s": 1.0, "requests": 10, "violations": 3}
+    # per-tenant counters ride along for the tenant-scoped burn windows
+    # (rejects are door-state, not SLO state: not sampled)
+    assert s["tenants"] == {"alpha": {"requests": 7, "violations": 1}}
     assert s["mfu"] == {"r21d": 0.61}
     assert s["nonfinite_total"] == 2
     json.dumps(s)  # JSON-safe by construction

@@ -136,6 +136,38 @@ def test_lookup_misses_on_absent_and_on_key_mismatch(store):
     assert not os.path.exists(fc.entry_path(key))
 
 
+def test_tenant_scope_never_serves_across_tenants(store, tmp_path):
+    """``cache_scope=tenant`` isolation (ISSUE 14): the requesting
+    tenant (thread-local request id, gateway-minted) salts the entry
+    key, so a hit can only be served to the tenant whose extraction
+    stored it — while the default ``shared`` scope keeps cross-tenant
+    dedup (one entry for everyone, the dominant win at scale)."""
+    from video_features_tpu.telemetry.context import use_request
+    _fc, video = store
+    feats = _feats()
+    scoped = fcache.FeatureCache(str(tmp_path / "cache"), "resnet",
+                                 "cfg" + "0" * 61, "wts" + "0" * 61,
+                                 scope="tenant")
+    with use_request("alpha-r1"):
+        key_a = scoped.store(video, feats)
+        assert scoped.lookup(video) is not None  # own entry: hit
+    with use_request("beta-r2"):
+        assert scoped.lookup(video) is None      # another tenant: MISS
+        assert scoped.key_for(video) != key_a
+    with use_request("alpha-r9"):
+        assert scoped.lookup(video) is not None  # same tenant, any rid
+    # untenanted work keys under its own sentinel, not alpha's
+    assert scoped.lookup(video) is None
+
+    shared = fcache.FeatureCache(str(tmp_path / "cache2"), "resnet",
+                                 "cfg" + "0" * 61, "wts" + "0" * 61,
+                                 scope="shared")
+    with use_request("alpha-r1"):
+        shared.store(video, feats)
+    with use_request("beta-r2"):
+        assert shared.lookup(video) is not None  # dedup across tenants
+
+
 def test_corrupted_tensor_fails_signature_and_is_dropped(store):
     fc, video = store
     key = fc.store(video, _feats())

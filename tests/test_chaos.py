@@ -456,6 +456,123 @@ def test_chaos_matrix(chaos_corpus, seed):
 # SIGKILLs ITSELF at its 2nd video attempt (no external observer races).
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# Gateway chaos (ISSUE 14): the network front door's failure semantics,
+# proven under the same seeded injection plane. Each seed composes the 3
+# ingress sites (gateway.read, gateway.spool_submit, spool.respond) with
+# a REAL in-process gateway + ServeLoop pair, and must end in vft-audit
+# PASS: torn client bodies answer 400 and retry cleanly (content-
+# addressed dedup), a lost spool submit is recovered by the deadline
+# sweep (terminal expired record, zero decode spans), a lost response
+# write is requeued and re-served idempotently.
+# ---------------------------------------------------------------------------
+
+GATEWAY_CHAOS_PLANS = {
+    30: "seed=30;gateway.read=torn@n1;gateway.spool_submit=enospc@n1;"
+        "spool.respond=drop@n1",
+    31: "seed=31;gateway.read=stall@n1;gateway.spool_submit=drop@n1",
+}
+
+
+def _http(base, method, path, data=None):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("seed", sorted(GATEWAY_CHAOS_PLANS))
+def test_gateway_chaos_matrix(sample_video, tmp_path, seed):
+    import threading
+
+    from video_features_tpu import serve
+    from video_features_tpu.audit import audit_run
+    from video_features_tpu.config import load_config, sanity_check
+    from video_features_tpu.gateway import GatewayServer
+    from video_features_tpu.utils import inject
+
+    spool = tmp_path / "spool"
+    cfg = load_config("resnet", {
+        "model_name": "resnet18", "device": "cpu",
+        "allow_random_weights": True, "on_extraction": "save_numpy",
+        "extraction_total": 6, "batch_size": 8, "cache": True,
+        "cache_dir": str(tmp_path / "cache"), "spool_dir": str(spool),
+        "serve_poll_interval_s": 0.05, "metrics_interval_s": 1,
+        "output_path": str(tmp_path / "out"),
+        "tmp_path": str(tmp_path / "tmp")})
+    sanity_check(cfg, require_videos=False)
+    plan = inject.arm_for_run(GATEWAY_CHAOS_PLANS[seed])
+    loop = gw = t = None
+    try:
+        loop = serve.ServeLoop(cfg, out_root=str(tmp_path / "out"))
+        t = threading.Thread(target=loop.run, daemon=True)
+        t.start()
+        gw = GatewayServer({"spool_dir": str(spool),
+                            "gateway_poll_interval_s": 0.05,
+                            "gateway_expire_grace_s": 0.5,
+                            "metrics_interval_s": 1}).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        data = Path(sample_video).read_bytes()
+
+        st, up = _http(base, "POST", "/v1/upload?name=clip.mp4", data)
+        if seed == 30:
+            # gateway.read=torn@n1: the first body read is cut short —
+            # an explicit 400, never a half-ingested inbox file
+            assert st == 400 and "torn" in up["error"], up
+            assert not list((spool / "inbox").iterdir())
+            st, up = _http(base, "POST", "/v1/upload?name=clip.mp4",
+                           data)
+        # seed 31's stall@n1 just delays this read; either way the
+        # (retried) upload lands exactly once, content-addressed
+        assert st == 201, up
+
+        if seed == 31:
+            # gateway.spool_submit=drop@n1: request A's submit is lost
+            # in flight; past deadline+grace the gateway writes the
+            # terminal expired record itself — the 202 still resolves
+            st, a = _http(base, "POST", "/v1/extract", json.dumps(
+                {"video_paths": [up["path"]],
+                 "timeout_s": 1.0}).encode())
+            assert st == 202
+            term = serve.wait_response(str(spool), a["id"],
+                                       timeout_s=60)
+            assert term["status"] == "deadline_exceeded", term
+            assert term["processed"] == 0
+
+        # the surviving request: must end done despite the armed faults
+        # (seed 30: first submit raises ENOSPC -> retried next pump
+        # pass; first response write dropped -> claim requeued and
+        # re-served off the feature cache)
+        st, b = _http(base, "POST", "/v1/extract", json.dumps(
+            {"video_paths": [up["path"]], "timeout_s": 240}).encode())
+        assert st == 202
+        resp = serve.wait_response(str(spool), b["id"], timeout_s=240)
+        assert resp["status"] == "done", resp
+        if seed == 30:
+            assert plan.fired.get("gateway.spool_submit") == 1
+            assert plan.fired.get("spool.respond") == 1
+    finally:
+        if gw is not None:
+            gw.stop()
+        if loop is not None:
+            loop.stop()
+        if t is not None:
+            t.join(timeout=120)
+        inject.disarm()
+    assert not t.is_alive()
+    ok, violations, _notes = audit_run(
+        str(tmp_path), cache_dir=str(tmp_path / "cache"),
+        expect_complete=True)
+    assert ok, (f"gateway seed {seed} failed the audit — replay with "
+                f"inject={GATEWAY_CHAOS_PLANS[seed]!r}:\n  "
+                + "\n  ".join(violations))
+
+
 @pytest.mark.slow
 def test_chaos_inject_worker_kill_replay(sample_video, tmp_path):
     from video_features_tpu.audit import audit_run

@@ -136,6 +136,52 @@ def test_serve_slo_and_cache_aggregation(tmp_path):
     assert "service p50/p95/p99=0.5/1.5/3.0s" in text
 
 
+def test_tenant_rollup_render_and_prom(tmp_path):
+    """Per-tenant observability (ISSUE 14): serve heartbeats carry
+    tenant request/violation/reject tallies, the gateway heartbeat
+    carries door rejections + sheds; vft-fleet merges them into one
+    attainment line per tenant and exports
+    vft_tenant_{requests,rejects,slo_violations}_total{tenant}."""
+    from video_features_tpu.telemetry.metrics import prometheus_text
+    root = tmp_path / "spool"
+    serve_a = {"state": "ready", "pending": 0, "inflight": 0,
+               "requests": {"done": 30}, "active_requests": [],
+               "slo": {"slo_s": 2.0, "requests": 30, "violations": 3,
+                       "attainment_pct": 90.0,
+                       "queue_wait": {"p50": 0.01, "p95": 0.2,
+                                      "p99": 0.4},
+                       "service": {"p50": 0.5, "p95": 1.5, "p99": 3.0}},
+               "tenants": {"alpha": {"requests": 20, "violations": 1,
+                                     "rejects": 0},
+                           "beta": {"requests": 10, "violations": 2,
+                                    "rejects": 4}}}
+    _write_hb(root, _hb("srv-1", NOW - 2, serve=serve_a))
+    gw_hb = _hb("gw-1", NOW - 2)
+    gw_hb["gateway"] = {"state": "ready", "queued_total": 0,
+                        "tenants": {"beta": {"accepted": 10,
+                                             "rejected": 5, "shed": 2,
+                                             "responded": 10,
+                                             "expired": 0,
+                                             "inflight": 0}}}
+    _write_hb(root, gw_hb)
+    agg = fleet_report.aggregate(str(root), now=NOW)
+    tenants = agg["serve"]["tenants"]
+    assert tenants["alpha"] == {"requests": 20, "violations": 1,
+                                "rejects": 0, "attainment_pct": 95.0}
+    # beta: serve rejects 4 + gateway door 5 rejected + 2 shed = 11
+    assert tenants["beta"]["rejects"] == 11
+    assert tenants["beta"]["attainment_pct"] == 80.0
+    text = "\n".join(fleet_report.render(agg))
+    assert "== tenants ==" in text
+    assert re.search(r"alpha\s+requests=20\s+violations=1\s+rejects=0"
+                     r"\s+attainment=95.0%", text)
+    prom = prometheus_text(fleet_report.build_prom_dump(agg))
+    assert 'vft_tenant_requests_total{tenant="alpha"} 20.0' in prom
+    assert 'vft_tenant_rejects_total{tenant="beta"} 11.0' in prom
+    assert 'vft_tenant_slo_violations_total{tenant="beta"} 2.0' in prom
+    assert 'vft_tenant_slo_attainment_pct{tenant="alpha"} 95.0' in prom
+
+
 def test_stitch_aligns_offset_anchors(tmp_path):
     """Two traces whose recorders started 5 s apart must land on ONE
     wall-clock timeline: the later host's events shift by +5e6 µs, each

@@ -1,0 +1,475 @@
+"""The network front door (gateway.py / `vft-gateway`, ISSUE 14):
+multi-tenant admission over real HTTP, end-to-end deadlines, and the
+shed-don't-collapse contract.
+
+Three layers of coverage, cheapest first:
+  - pure units: tenant-table validation, token-bucket determinism, the
+    smooth weighted-fair-share release order;
+  - HTTP admission against a BACKENDLESS gateway (ephemeral port, no
+    extractor construction): auth 401, rate/in-flight 429 with a
+    computed Retry-After, cross-tenant isolation 403, content-addressed
+    upload dedup, 503 shed on a dead backend;
+  - deadline semantics against a real ``ServeLoop`` with the video step
+    stubbed: expiry while queued (cancelled at claim, ZERO video work),
+    expiry mid-request between videos (partial results + terminal
+    ``expired/`` record, never a ``done/`` response), and clock-skew
+    tolerance (deadlines are gateway-duration-relative; a client's
+    forged wall clock changes nothing).
+
+The real-extraction E2E twin (upload -> extract -> bit-identical
+features -> audit PASS) is scripts/check_gateway_smoke.py (CI quick
+gate); the chaos seeds live in tests/test_chaos.py.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu import gateway, serve
+from video_features_tpu.gateway import (GatewayServer, TokenBucket,
+                                        load_tenant_table)
+from video_features_tpu.telemetry.jsonl import write_json_atomic
+
+pytestmark = pytest.mark.quick
+
+TENANTS_YML = """
+tenants:
+  alpha:
+    key: alpha-k
+    rate_rps: 100
+    burst: 100
+    max_inflight: 2
+    priority: high
+  beta:
+    key: beta-k
+    rate_rps: 0.5
+    burst: 1
+    max_inflight: 2
+    priority: low
+"""
+
+
+def _call(base, method, path, data=None, key=None, headers=None):
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if key:
+        req.add_header("X-API-Key", key)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture
+def gw(tmp_path):
+    ty = tmp_path / "tenants.yml"
+    ty.write_text(TENANTS_YML)
+    g = GatewayServer({"spool_dir": str(tmp_path / "spool"),
+                       "gateway_tenants": str(ty),
+                       "gateway_poll_interval_s": 0.05,
+                       "gateway_expire_grace_s": 0.5,
+                       "metrics_interval_s": 1}).start()
+    yield g, f"http://127.0.0.1:{g.port}"
+    g.stop()
+
+
+# -- units -------------------------------------------------------------------
+
+def test_tenant_table_validation(tmp_path):
+    p = tmp_path / "tenants.yml"
+    p.write_text(TENANTS_YML)
+    table = load_tenant_table(str(p))
+    assert {t.name for t in table.values()} == {"alpha", "beta"}
+    assert table["alpha-k"].priority == "high"
+    assert table["beta-k"].max_inflight == 2
+    # open mode: no table -> the single implicit keyless tenant
+    open_table = load_tenant_table(None)
+    assert None in open_table and open_table[None].name == "anon"
+
+    def bad(yml, needle):
+        p.write_text(yml)
+        with pytest.raises(ValueError, match=needle):
+            load_tenant_table(str(p))
+
+    bad("tenants: {}", "at least one tenant")
+    # a dashed name would break the {tenant}-{rid} prefix split
+    bad("tenants:\n  has-dash:\n    key: k\n", r"\[a-z0-9_\]\+")
+    bad("tenants:\n  a:\n    priority: high\n", "needs a string 'key'")
+    bad("tenants:\n  a:\n    key: k\n  b:\n    key: k\n", "duplicates")
+    bad("tenants:\n  a:\n    key: k\n    priority: urgent\n",
+        "priority")
+    bad("tenants:\n  a:\n    key: k\n    rate_rps: 0\n", "rate_rps")
+    bad("tenants:\n  a:\n    key: k\n    max_inflight: 0\n",
+        "max_inflight")
+
+
+def test_token_bucket_deterministic_retry_after():
+    clock = [0.0]
+    b = TokenBucket(rate_rps=2.0, burst=3, clock=lambda: clock[0])
+    # burst drains, then the refusal names the exact wait for 1 token
+    assert [b.try_take()[0] for _ in range(3)] == [True, True, True]
+    ok, retry = b.try_take()
+    assert not ok and retry == pytest.approx(0.5)
+    clock[0] += 0.5  # exactly one token refilled
+    assert b.try_take() == (True, 0.0)
+    assert not b.try_take()[0]
+    clock[0] += 100.0  # refill clamps at burst, never beyond
+    assert [b.try_take()[0] for _ in range(4)] == [True, True, True,
+                                                  False]
+
+
+def test_weighted_fair_share_release_order(tmp_path):
+    """Smooth WRR over high/normal/low = 4/2/1: with all three classes
+    backlogged, any 7 consecutive releases split 4/2/1 and high is
+    never starved-out nor allowed to starve low."""
+    g = GatewayServer({"spool_dir": str(tmp_path / "spool")})
+    t = gateway.Tenant("t", None, **gateway.TENANT_DEFAULTS)
+    for klass in ("high", "normal", "low"):
+        for i in range(7):
+            p = gateway._Pending(f"{klass}{i}", t, ["v"], None)
+            p.klass = klass
+            g._queues[klass].append(p)
+    order = [g._pick_class() for _ in range(7)]
+    assert order == ["high", "normal", "high", "low", "high", "normal",
+                     "high"]
+    # pop what _pick_class scheduled so the next window repeats 4:2:1
+    for klass in order:
+        g._queues[klass].popleft()
+    assert [g._pick_class() for _ in range(7)].count("high") == 4
+    g.httpd.server_close()
+    g.recorder.close()
+
+
+# -- HTTP admission (no backend) ----------------------------------------------
+
+def test_admission_auth_rate_inflight_isolation(gw):
+    g, base = gw
+    body = json.dumps({"video_paths": ["/v.mp4"], "timeout_s": 60}
+                      ).encode()
+    # 401: unknown/missing key
+    assert _call(base, "POST", "/v1/extract", body)[0] == 401
+    assert _call(base, "POST", "/v1/extract", body, key="nope")[0] == 401
+    # beta: burst 1 -> second immediate request is a rate 429 whose
+    # Retry-After is computed from the bucket (0.5 rps -> 2s)
+    st1, acc, _ = _call(base, "POST", "/v1/extract", body, key="beta-k")
+    st2, rej, h2 = _call(base, "POST", "/v1/extract", body, key="beta-k")
+    assert (st1, st2) == (202, 429)
+    assert h2["Retry-After"] == "2" and rej["retry_after_s"] == 2
+    # alpha: generous rate but max_inflight=2 -> third open request 429
+    rids = []
+    for _ in range(2):
+        st, b, _ = _call(base, "POST", "/v1/extract", body, key="alpha-k")
+        assert st == 202
+        rids.append(b["id"])
+    st, b, h = _call(base, "POST", "/v1/extract", body, key="alpha-k")
+    assert st == 429 and "max_inflight" in b["error"]
+    assert int(h["Retry-After"]) >= 1
+    # tenant identity is minted into the id; isolation holds on poll
+    assert all(r.startswith("alpha-") for r in rids)
+    st, b, _ = _call(base, "GET", f"/v1/requests/{rids[0]}", key="beta-k")
+    assert st == 403
+    st, b, _ = _call(base, "GET", f"/v1/requests/{rids[0]}",
+                     key="alpha-k")
+    assert st == 202 and b["status"] in ("queued", "submitted")
+    # healthz needs no auth and reports both planes
+    st, b, _ = _call(base, "GET", "/healthz")
+    assert st == 200 and b["gateway"]["state"] == "ready"
+    assert b["backend"]["state"] == "absent"
+
+
+def test_upload_content_addressed_idempotent(gw):
+    g, base = gw
+    import hashlib
+    data = b"not really mp4 bytes, but bytes"
+    sha = hashlib.sha256(data).hexdigest()
+    st1, up1, _ = _call(base, "POST", "/v1/upload?name=clip.mp4", data,
+                        key="alpha-k")
+    assert st1 == 201 and up1["dedup"] is False and up1["sha256"] == sha
+    assert Path(up1["path"]).read_bytes() == data
+    # the retry of identical bytes is a HIT, not duplicate work
+    st2, up2, _ = _call(base, "POST", "/v1/upload?name=clip.mp4", data,
+                        key="alpha-k")
+    assert st2 == 200 and up2["dedup"] is True
+    assert up2["path"] == up1["path"]
+    assert len(list(Path(g.inbox_dir).iterdir())) == 1
+    # a checksummed upload whose bytes were corrupted in transit is a
+    # client-visible 400, never a silently half-ingested request
+    st3, err, _ = _call(base, "POST", "/v1/upload?name=clip.mp4",
+                        b"corrupted bytes", key="alpha-k",
+                        headers={"X-Content-SHA256": sha})
+    assert st3 == 400 and "mismatch" in err["error"]
+
+
+def test_shed_503_on_dead_backend(gw):
+    g, base = gw
+    # the only server on the spool wrote a FINAL heartbeat: heartbeat
+    # liveness says there is nobody to do the work -> shed, don't queue
+    write_json_atomic(Path(g.spool_dir) / "_heartbeat_srv-1.json",
+                      {"host_id": "srv-1", "time": time.time(),
+                       "interval_s": 1.0, "final": True,
+                       "serve": {"state": "exited"}})
+    body = json.dumps({"video_paths": ["/v.mp4"]}).encode()
+    st, b, h = _call(base, "POST", "/v1/extract", body, key="alpha-k")
+    assert st == 503 and "backend_exited" in b["error"]
+    assert int(h["Retry-After"]) >= 1
+    section = g._gateway_section()
+    assert section["tenants"]["alpha"]["shed"] == 1
+
+
+# -- deadlines (real ServeLoop, stubbed video step) ---------------------------
+
+def _make_loop(tmp_path, sample_video):
+    from video_features_tpu.config import load_config, sanity_check
+    spool = tmp_path / "spool"
+    cfg = load_config("resnet", {
+        "model_name": "resnet18", "device": "cpu",
+        "allow_random_weights": True, "on_extraction": "save_numpy",
+        "extraction_total": 6, "batch_size": 8, "cache": False,
+        "spool_dir": str(spool), "serve_poll_interval_s": 0.05,
+        "metrics_interval_s": 1,
+        "output_path": str(tmp_path / "out"),
+        "tmp_path": str(tmp_path / "tmp")})
+    sanity_check(cfg, require_videos=False)
+    return serve.ServeLoop(cfg, out_root=str(tmp_path / "out")), str(spool)
+
+
+def _claim(loop, spool, rid):
+    src = Path(spool) / "requests" / f"{rid}.json"
+    dst = Path(loop.claim_dir) / f"{rid}.json"
+    os.rename(src, dst)
+    return str(dst)
+
+
+def test_deadline_expired_while_queued_cancelled_at_claim(
+        sample_video, tmp_path):
+    """Expiry while queued: the claim-time wasted-work guard cancels the
+    request BEFORE any video runs — terminal ``expired/`` record, no
+    ``done/`` response, zero extraction calls."""
+    loop, spool = _make_loop(tmp_path, sample_video)
+    calls = []
+    loop._run_one_video = lambda v: calls.append(v) or {"resnet": "done"}
+    rid = serve.submit_request(spool, [str(sample_video)],
+                              request_id="t1-queuedexp",
+                              deadline=time.time() - 0.1)
+    loop._process(_claim(loop, spool, rid))
+    assert calls == []
+    assert serve.read_response(spool, rid) is None  # never a done/
+    term = serve.read_terminal(spool, rid)
+    assert term["status"] == "deadline_exceeded"
+    assert term["expired_at"] == "claim" and term["processed"] == 0
+    assert term["tenant"] == "t1"
+    assert loop._tallies["deadline_exceeded"] == 1
+    # the claim is released, not stranded
+    assert not list(Path(loop.claim_dir).glob("*.json"))
+    # tenant accounting: an expired request is a violated request
+    assert loop._tenants["t1"] == {"requests": 1, "violations": 1,
+                                   "rejects": 0}
+    loop.recorder.close()
+
+
+def test_deadline_expires_mid_request_partial_results(
+        sample_video, tmp_path):
+    """Expiry between videos: whatever finished stays (partial results +
+    statuses in the terminal record); the remaining videos never run."""
+    loop, spool = _make_loop(tmp_path, sample_video)
+
+    def slow_video(v):
+        time.sleep(0.35)
+        return {"resnet": "done"}
+
+    loop._run_one_video = slow_video
+    vids = [f"/v{i}.mp4" for i in range(4)]
+    rid = serve.submit_request(spool, vids, request_id="t1-midexp",
+                              deadline=time.time() + 0.5)
+    loop._process(_claim(loop, spool, rid))
+    term = serve.read_terminal(spool, rid)
+    assert term["status"] == "deadline_exceeded"
+    assert term["expired_at"] == "mid_request"
+    assert 1 <= term["processed"] < len(vids)
+    done_vids = set(term["videos"])
+    assert done_vids == set(vids[:term["processed"]])
+    assert all(v == {"resnet": "done"} for v in term["videos"].values())
+    assert serve.read_response(spool, rid) is None
+    loop.recorder.close()
+
+
+def test_deadlines_are_duration_relative_not_client_clock(
+        sample_video, tmp_path):
+    """Clock-skew tolerance, both halves: (a) the gateway computes the
+    deadline from ITS clock + the requested duration — the client's
+    wall clock never enters; (b) the server honors the absolute
+    deadline even when the request's client-stamped ``time`` field is
+    forged hours off (it only skews the reported queue-wait, never
+    expiry)."""
+    # (a) gateway half
+    g = GatewayServer({"spool_dir": str(tmp_path / "gspool"),
+                       "gateway_poll_interval_s": 0.05})
+    tenant = g.tenants[None]
+    before = time.time()
+    code, body, _ = g.admit(tenant, ["/v.mp4"], 60.0)
+    assert code == 202
+    assert before + 59 <= body["deadline"] <= time.time() + 61
+    g.httpd.server_close()
+    g.recorder.close()
+
+    # (b) server half: forge the client clock 3 hours ahead; a valid
+    # 60s deadline from the coordinating (gateway) clock still serves
+    loop, spool = _make_loop(tmp_path, sample_video)
+    loop._run_one_video = lambda v: {"resnet": "done"}
+    rid = serve.submit_request(spool, ["/v.mp4"], request_id="t1-skew",
+                              deadline=time.time() + 60)
+    req_path = Path(spool) / "requests" / f"{rid}.json"
+    req = json.loads(req_path.read_text())
+    req["time"] = time.time() + 3 * 3600  # the skewed client clock
+    write_json_atomic(req_path, req)
+    loop._process(_claim(loop, spool, rid))
+    resp = serve.read_response(spool, rid)
+    assert resp is not None and resp["status"] == "done"
+    assert resp["wait_s"] == 0.0  # clamped, not negative
+    assert serve.read_terminal(spool, rid)["status"] == "done"
+    loop.recorder.close()
+
+
+def test_gateway_expires_spooled_request_and_audits_clean(tmp_path):
+    """No server ever comes: the gateway's sweep withdraws the spooled
+    request at its deadline and writes the terminal record itself —
+    every 202 resolves, and the whole tree passes vft-audit."""
+    from video_features_tpu.audit import audit_run
+    g = GatewayServer({"spool_dir": str(tmp_path / "spool"),
+                       "gateway_poll_interval_s": 0.05,
+                       "gateway_expire_grace_s": 0.5,
+                       "metrics_interval_s": 1}).start()
+    base = f"http://127.0.0.1:{g.port}"
+    st, acc, _ = _call(base, "POST", "/v1/extract", json.dumps(
+        {"video_paths": ["/v.mp4"], "timeout_s": 0.6}).encode())
+    assert st == 202
+    term = serve.wait_response(str(tmp_path / "spool"), acc["id"],
+                               timeout_s=30)
+    assert term["status"] == "deadline_exceeded"
+    assert term["expired_at"] in ("queued", "spooled")
+    # the withdrawn request is gone from requests/
+    assert not list((tmp_path / "spool" / "requests").glob("*.json"))
+    g.stop()
+    ok, violations, _notes = audit_run(str(tmp_path),
+                                       expect_complete=True)
+    assert ok, "\n".join(violations)
+    events = [json.loads(l)["event"]
+              for l in Path(g.journal_path).read_text().splitlines()]
+    assert "accepted" in events and "expired" in events
+
+
+# -- audit invariants (crafted violations must FAIL) --------------------------
+
+def _spool_skeleton(root: Path) -> Path:
+    spool = root / "spool"
+    for d in ("requests", "claimed", "done", "expired", "inbox"):
+        (spool / d).mkdir(parents=True)
+    return spool
+
+
+def test_audit_flags_done_and_expired_conflict(tmp_path):
+    from video_features_tpu.audit import audit_run
+    spool = _spool_skeleton(tmp_path)
+    write_json_atomic(spool / "done" / "t1-r1.json",
+                      {"id": "t1-r1", "status": "done"})
+    write_json_atomic(spool / "expired" / "t1-r1.json",
+                      {"id": "t1-r1", "status": "deadline_exceeded",
+                       "processed": 0, "videos": {}})
+    ok, violations, _ = audit_run(str(tmp_path))
+    assert not ok
+    assert any("mutually exclusive" in v for v in violations)
+    # and a wrong-status expired record is its own violation
+    write_json_atomic(spool / "expired" / "t1-r2.json",
+                      {"id": "t1-r2", "status": "done"})
+    ok, violations, _ = audit_run(str(tmp_path))
+    assert any("status=deadline_exceeded" in v for v in violations)
+
+
+def test_audit_flags_claim_expired_request_with_spans(tmp_path):
+    from video_features_tpu.audit import audit_run
+    spool = _spool_skeleton(tmp_path)
+    write_json_atomic(spool / "expired" / "t1-r1.json",
+                      {"id": "t1-r1", "status": "deadline_exceeded",
+                       "processed": 0, "videos": {}})
+    # a span stamped with the expired request's id = work was burned
+    with open(spool / "_telemetry.jsonl", "w") as f:
+        f.write(json.dumps({"video": "v.mp4", "status": "done",
+                            "request_id": "t1-r1"}) + "\n")
+    ok, violations, _ = audit_run(str(tmp_path))
+    assert not ok
+    assert any("wasted-work guard" in v for v in violations)
+
+
+def test_audit_flags_orphaned_inbox_and_unreconciled_tenants(tmp_path):
+    from video_features_tpu.audit import audit_run
+    spool = _spool_skeleton(tmp_path)
+    jpath = spool / "_gateway_gw-1.jsonl"
+    recs = [
+        {"schema": gateway.JOURNAL_SCHEMA, "event": "upload",
+         "tenant": "alpha", "path": str(spool / "inbox" / "aa.mp4")},
+        {"schema": gateway.JOURNAL_SCHEMA, "event": "accepted",
+         "id": "alpha-r1", "tenant": "alpha"},
+        {"schema": gateway.JOURNAL_SCHEMA, "event": "accepted",
+         "id": "alpha-r2", "tenant": "alpha"},
+        {"schema": gateway.JOURNAL_SCHEMA, "event": "rejected",
+         "id": "beta-r9", "tenant": "beta", "reason": "rate"},
+    ]
+    jpath.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    (spool / "inbox" / "aa.mp4").write_bytes(b"a")
+    (spool / "inbox" / "orphan.mp4").write_bytes(b"o")  # never journaled
+    write_json_atomic(spool / "done" / "alpha-r1.json",
+                      {"id": "alpha-r1", "status": "done"})
+    # alpha-r2 accepted but never terminal; beta-r9 was refused at the
+    # door yet somehow reached the spool
+    write_json_atomic(spool / "requests" / "beta-r9.json",
+                      {"id": "beta-r9", "video_paths": []})
+    ok, violations, _ = audit_run(str(tmp_path), expect_complete=True)
+    assert not ok
+    assert any("orphaned upload" in v and "orphan.mp4" in v
+               for v in violations)
+    assert any("alpha-r2" in v and "no terminal record" in v
+               for v in violations)
+    assert any("beta-r9" in v and "refused" in v for v in violations)
+    assert any("tenant alpha" in v and "reconcile" in v
+               for v in violations)
+    # fixing the ledger turns the audit green
+    (spool / "inbox" / "orphan.mp4").unlink()
+    (spool / "requests" / "beta-r9.json").unlink()
+    write_json_atomic(spool / "expired" / "alpha-r2.json",
+                      {"id": "alpha-r2", "status": "deadline_exceeded",
+                       "processed": 0, "videos": {}})
+    ok, violations, _ = audit_run(str(tmp_path), expect_complete=True)
+    assert ok, "\n".join(violations)
+
+
+# -- SIGTERM drain ------------------------------------------------------------
+
+def test_stop_flushes_queued_requests_into_spool(tmp_path):
+    """The drain contract: stop accepting, flush accepted-but-unsubmitted
+    requests into the spool (their 202 was a promise), final heartbeat."""
+    g = GatewayServer({"spool_dir": str(tmp_path / "spool"),
+                       # bound 0 releases nothing while running: every
+                       # accepted request is still edge-queued at stop
+                       "gateway_spool_bound": 1,
+                       "gateway_poll_interval_s": 30,
+                       "metrics_interval_s": 30})
+    tenant = g.tenants[None]
+    rids = [g.admit(tenant, ["/v.mp4"], None)[1]["id"] for _ in range(3)]
+    g.start()
+    g.stop()
+    spooled = {p.stem for p
+               in (tmp_path / "spool" / "requests").glob("*.json")}
+    assert spooled == set(rids)
+    hb = json.loads(next((tmp_path / "spool").glob(
+        "_heartbeat_gw-*.json")).read_text())
+    assert hb["final"] and hb["gateway"]["state"] == "exited"
+    events = [json.loads(l)["event"]
+              for l in Path(g.journal_path).read_text().splitlines()]
+    assert events.count("submitted") == 3 and events[-1] == "drain"

@@ -49,6 +49,24 @@ def test_parse_plan_round_trip():
     assert p.rules["queue.claim"].trigger == "every"
 
 
+def test_parse_plan_gateway_sites(tmp_path):
+    """The ISSUE-14 ingress sites parse with their behavioral faults —
+    and the grammar still rejects kinds that make no sense there."""
+    p = inject.parse_plan(
+        "seed=3;gateway.read=torn@n1;gateway.spool_submit=drop@n2;"
+        "spool.respond=drop@every2")
+    assert set(p.rules) == {"gateway.read", "gateway.spool_submit",
+                            "spool.respond"}
+    inject.parse_plan("gateway.read=stall@n1")  # the slow-client fault
+    inject.parse_plan("gateway.spool_submit=enospc@n1")
+    with pytest.raises(ValueError, match="only applies"):
+        inject.parse_plan("gateway.read=drop@n1")
+    with pytest.raises(ValueError, match="only applies"):
+        inject.parse_plan("spool.respond=torn@n1")
+    with pytest.raises(ValueError, match="only applies"):
+        inject.parse_plan("sink.fsync=stall@n1")
+
+
 def test_parse_plan_default_trigger_is_first_hit():
     p = inject.parse_plan("seed=1;sink.rename=drop")
     r = p.rules["sink.rename"]

@@ -40,6 +40,15 @@ durability machinery promises to hold under ANY interleaving of crashes
      that names one), every store entry must load, carry the current
      schema, and match its stored per-tensor signatures
      (verify-before-trust, cache.py).
+ 10. **gateway/spool lifecycle reconciles** — for every serve spool
+     under the root: ``expired/`` records carry status
+     ``deadline_exceeded`` and are mutually exclusive with ``done/``
+     responses; a request expired at claim time (``processed=0``)
+     produced ZERO video spans (the wasted-work guard, serve.py); every
+     ``inbox/`` upload is named by a gateway journal record; requests
+     the gateway rejected/shed at the door never reached the spool; and
+     per-tenant accepted counts in the gateway journals reconcile with
+     the spool's terminal (done/expired) markers (gateway.py).
 
 Violations are states the machinery PROMISES cannot happen no matter
 where a worker died; notes are recoverable in-flight states a killed
@@ -299,6 +308,132 @@ class Audit:
                         "failure journal — retry_failed=true could never "
                         "lift it and restarted workers would re-dispatch")
 
+    # -- invariant 10: gateway/spool lifecycle reconciles --------------------
+    def _span_request_counts(self) -> Dict[str, int]:
+        """``{request_id: span_count}`` over every span file under the
+        root — the evidence for 'expired at claim = zero work'."""
+        from .telemetry.jsonl import read_jsonl
+        out: Dict[str, int] = {}
+        for spath in sorted(self.root.rglob("_telemetry.jsonl")):
+            for rec in read_jsonl(spath):
+                rid = rec.get("request_id")
+                if rid:
+                    out[str(rid)] = out.get(str(rid), 0) + 1
+        return out
+
+    def check_spools(self) -> None:
+        from .telemetry.jsonl import read_jsonl
+        spools = sorted({p.parent for p in self.root.rglob("requests")
+                         if p.is_dir() and (p.parent / "done").is_dir()
+                         and (p.parent / "claimed").is_dir()})
+        if not spools:
+            return
+        self.stats["spools"] = len(spools)
+        span_counts: Optional[Dict[str, int]] = None  # computed lazily
+        for spool in spools:
+            done_ids = {p.stem for p in (spool / "done").glob("*.json")}
+            expired_dir = spool / "expired"
+            expired_files = (sorted(expired_dir.glob("*.json"))
+                             if expired_dir.is_dir() else [])
+            self.stats["expired_records"] = \
+                self.stats.get("expired_records", 0) + len(expired_files)
+            for p in expired_files:
+                rec = self._read_json(p)
+                rid = p.stem
+                if rec is None or rec.get("status") != "deadline_exceeded":
+                    self.violation(
+                        f"{self._rel(p)}: expired record must carry "
+                        f"status=deadline_exceeded "
+                        f"(got {(rec or {}).get('status')!r})")
+                    continue
+                if rid in done_ids:
+                    self.violation(
+                        f"request {rid}: BOTH a done/ response and an "
+                        "expired/ record exist — deadline_exceeded and "
+                        "completion are mutually exclusive terminal "
+                        "states (serve.py)")
+                if int(rec.get("processed") or 0) == 0:
+                    if span_counts is None:
+                        span_counts = self._span_request_counts()
+                    if span_counts.get(rid):
+                        self.violation(
+                            f"request {rid}: expired at claim "
+                            f"(processed=0) yet produced "
+                            f"{span_counts[rid]} video span(s) — the "
+                            "wasted-work guard must cancel BEFORE any "
+                            "decode/device time burns")
+
+            journals = sorted(spool.glob("_gateway_*.jsonl"))
+            if not journals:
+                continue
+            self.stats["gateway_journals"] = \
+                self.stats.get("gateway_journals", 0) + len(journals)
+            events = [rec for j in journals for rec in read_jsonl(j)]
+
+            # no orphaned uploads: every inbox file entered through the
+            # journaled (content-addressed, atomic) upload path
+            journaled = {os.path.basename(str(rec.get("path")))
+                         for rec in events
+                         if rec.get("event") == "upload" and rec.get("path")}
+            inbox = spool / "inbox"
+            if inbox.is_dir():
+                files = [p for p in sorted(inbox.iterdir()) if p.is_file()]
+                self.stats["inbox_files"] = \
+                    self.stats.get("inbox_files", 0) + len(files)
+                for p in files:
+                    if p.name not in journaled:
+                        self.violation(
+                            f"orphaned upload {self._rel(p)}: no gateway "
+                            "journal record names it — every inbox file "
+                            "must arrive through the journaled upload "
+                            "path (gateway.py store_upload)")
+
+            accepted: Dict[str, str] = {}
+            refused: List[str] = []
+            for rec in events:
+                ev, rid = rec.get("event"), rec.get("id")
+                if not rid:
+                    continue
+                if ev == "accepted":
+                    accepted[str(rid)] = str(rec.get("tenant"))
+                elif ev in ("rejected", "shed"):
+                    refused.append(str(rid))
+            expired_ids = {p.stem for p in expired_files}
+            for rid in sorted(refused):
+                if rid in done_ids or rid in expired_ids or \
+                        (spool / "requests" / f"{rid}.json").exists():
+                    self.violation(
+                        f"request {rid} was refused (429/503) at the "
+                        "gateway door yet reached the spool — a refused "
+                        "request must produce no work")
+
+            # per-tenant reconcile: accepted == terminal, rid by rid
+            per_tenant: Dict[str, Dict[str, int]] = {}
+            for rid, tenant in sorted(accepted.items()):
+                t = per_tenant.setdefault(tenant,
+                                          {"accepted": 0, "terminal": 0})
+                t["accepted"] += 1
+                if rid in done_ids or rid in expired_ids:
+                    t["terminal"] += 1
+                elif self.expect_complete:
+                    self.violation(
+                        f"gateway-accepted request {rid} (tenant "
+                        f"{tenant}) has no terminal record — every 202 "
+                        "must resolve to a done/ response or an "
+                        "expired/ record by drain time")
+                else:
+                    self.note(f"gateway-accepted request {rid} still "
+                              "open (in flight — resolves by response "
+                              "or deadline)")
+            if self.expect_complete:
+                for tenant, t in sorted(per_tenant.items()):
+                    if t["accepted"] != t["terminal"]:
+                        self.violation(
+                            f"tenant {tenant}: {t['accepted']} accepted "
+                            f"vs {t['terminal']} terminal — per-tenant "
+                            "journal counts must reconcile with the "
+                            "spool's done/expired markers")
+
     # -- invariant 7: health digests re-verify -------------------------------
     def check_health(self) -> None:
         import numpy as np
@@ -446,6 +581,7 @@ class Audit:
         self.check_tmp_litter()
         self.check_jsonl()
         self.check_queue(journal)
+        self.check_spools()
         self.check_health()
         self.check_artifact_spans()
         self.check_cache()

@@ -86,8 +86,11 @@ NON_SEMANTIC_KEYS = frozenset({
     "fleet", "fleet_lease_s", "fleet_max_reclaims", "fleet_canary",
     # the cache's own knobs must not key the cache; the compile cache's
     # knobs (compile_cache.py) likewise change where executables come
-    # from, never what any program computes
-    "cache", "cache_dir", "compile_cache", "compile_cache_dir",
+    # from, never what any program computes. cache_scope changes WHO may
+    # observe an entry (a tenant salt in the key, below), never the
+    # feature values — it must not perturb the config fingerprint
+    "cache", "cache_dir", "cache_scope",
+    "compile_cache", "compile_cache_dir",
     # chaos-injection plans perturb scheduling/IO, never feature values
     # (a fault either recovers bit-identically or fails the video)
     "inject",
@@ -95,6 +98,11 @@ NON_SEMANTIC_KEYS = frozenset({
     "spool_dir", "serve_max_pending", "serve_poll_interval_s",
     "serve_idle_exit_s", "serve_max_requests", "serve_workers",
     "serve_warmup_video", "serve_slo_s",
+    # gateway knobs (gateway.py): ingress admission/deadline plumbing
+    "gateway_tenants", "gateway_port", "gateway_host",
+    "gateway_max_queued", "gateway_spool_bound", "gateway_max_body_mb",
+    "gateway_poll_interval_s", "gateway_expire_grace_s",
+    "gateway_default_timeout_s",
     # sink format changes the FILE, not the feature values; entries store
     # arrays and are written through whichever sink the run uses
     "on_extraction", "show_pred",
@@ -205,10 +213,18 @@ def weights_fingerprint(capture: Optional[List[dict]]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
-def entry_key(content_id: str, config_fp: str, weights_fp: str) -> str:
-    """The store key: one sha256 over the three identity components."""
+def entry_key(content_id: str, config_fp: str, weights_fp: str,
+              tenant: Optional[str] = None) -> str:
+    """The store key: one sha256 over the three identity components —
+    plus, under ``cache_scope=tenant``, the requesting tenant's id as a
+    fourth component, so one tenant's entries can never be observed by
+    (or served to) another. The default ``shared`` scope omits it: at
+    fleet scale cross-tenant dedup of repeat content is the dominant
+    win, and byte-identical inputs hash to one entry for everyone."""
+    salt = f"\ntenant:{tenant}" if tenant else ""
     return hashlib.sha256(
-        f"{content_id}\n{config_fp}\n{weights_fp}".encode()).hexdigest()
+        f"{content_id}\n{config_fp}\n{weights_fp}{salt}".encode()
+    ).hexdigest()
 
 
 def default_cache_dir() -> str:
@@ -229,11 +245,13 @@ class FeatureCache:
 
     def __init__(self, root: str, family: str, config_fp: str,
                  weights_fp: str, *, fps: Optional[float] = None,
-                 total: Optional[int] = None) -> None:
+                 total: Optional[int] = None,
+                 scope: str = "shared") -> None:
         self.root = str(root)
         self.family = str(family)
         self.config_fp = config_fp
         self.weights_fp = weights_fp
+        self.scope = str(scope)
         self._fps = fps
         self._total = total
 
@@ -261,11 +279,21 @@ class FeatureCache:
         return cls(os.path.join(root, str(ext.feature_type)),
                    ext.feature_type, config_fp, weights_fp,
                    fps=args.get("extraction_fps"),
-                   total=args.get("extraction_total"))
+                   total=args.get("extraction_total"),
+                   scope=args.get("cache_scope", "shared") or "shared")
 
     # -- keying ------------------------------------------------------------
     def key_for(self, video_path: str) -> str:
         cid = content_identity(video_path, self._fps, self._total)
+        if self.scope == "tenant":
+            # isolation semantics (docs/serving.md): the requesting
+            # tenant (thread-local, minted into the request id by the
+            # gateway) salts the key, so a hit can only ever be served
+            # to the tenant whose extraction stored it. Untenanted work
+            # (batch CLI, spool-direct) keys under its own sentinel.
+            from .telemetry.context import current_tenant
+            return entry_key(cid, self.config_fp, self.weights_fp,
+                             tenant=current_tenant() or "_untenanted")
         return entry_key(cid, self.config_fp, self.weights_fp)
 
     def entry_path(self, key: str) -> str:

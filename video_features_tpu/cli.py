@@ -106,6 +106,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         # (serve.py; also installed as the `vft-serve` console script)
         from .serve import serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "gateway":
+        # network front door: `python main.py gateway spool_dir=...`
+        # routes to the HTTP ingress (gateway.py; also installed as the
+        # `vft-gateway` console script)
+        from .gateway import gateway_main
+        return gateway_main(argv[1:])
     if argv and argv[0] == "warmup":
         # ahead-of-time compile warmup: `python main.py warmup resnet ...`
         # routes to the store populator (compile_cache.py; also installed

@@ -367,6 +367,21 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
         raise ValueError(f"cache_dir={cd!r}: expected a directory path or "
                          "null (null -> VFT_CACHE_DIR or "
                          "~/.cache/video_features_tpu/feature_cache)")
+    cs = args.get("cache_scope", "shared") or "shared"
+    if cs not in ("shared", "tenant"):
+        raise ValueError(f"cache_scope={cs!r}: expected 'shared' (one "
+                         "entry per content — cross-tenant dedup, the "
+                         "dominant win at scale) or 'tenant' (the "
+                         "requesting tenant salts the key: no tenant "
+                         "ever observes a hit on another's content — "
+                         "docs/serving.md)")
+
+    # gateway keys (gateway.py): tenant table, port, admission bounds —
+    # full validation lives with the gateway so vft-gateway and any
+    # serve/cli run carrying gateway_* keys fail a typo identically
+    if any(str(k).startswith("gateway_") for k in args):
+        from .gateway import validate_gateway_args
+        validate_gateway_args(args)
 
     # compile-cache keys (compile_cache.py): the fleet-shared persistent
     # XLA store — a typo'd switch must not silently compile cold forever

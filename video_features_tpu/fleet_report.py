@@ -285,6 +285,14 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
     cc_entries: set = set()
     slo_hosts: List[dict] = []
     slo_totals = {"requests": 0, "violations": 0}
+    # per-tenant roll-up (the gateway arc, gateway.py): answered/violated
+    # from serve heartbeats, door rejections + sheds from gateway
+    # heartbeats — one attainment line per tenant, fleet-wide
+    tenant_totals: Dict[str, Dict[str, object]] = {}
+
+    def _tenant(t: str) -> Dict[str, object]:
+        return tenant_totals.setdefault(
+            str(t), {"requests": 0, "violations": 0, "rejects": 0})
     idle_inputs = {"idle_wait_s_total": 0.0, "uptime_s": 0.0,
                    "fleet_hosts": 0}
     for e in current:
@@ -324,6 +332,22 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
                 "requests": serve.get("requests") or {}, "slo": slo})
             slo_totals["requests"] += int(slo.get("requests") or 0)
             slo_totals["violations"] += int(slo.get("violations") or 0)
+            for t, v in (serve.get("tenants") or {}).items():
+                tt = _tenant(t)
+                tt["requests"] += int(v.get("requests") or 0)
+                tt["violations"] += int(v.get("violations") or 0)
+                tt["rejects"] += int(v.get("rejects") or 0)
+        gw = hb.get("gateway")
+        if isinstance(gw, dict):
+            for t, v in (gw.get("tenants") or {}).items():
+                tt = _tenant(t)
+                tt["rejects"] += (int(v.get("rejected") or 0)
+                                  + int(v.get("shed") or 0))
+    for tt in tenant_totals.values():
+        n = int(tt["requests"])
+        tt["attainment_pct"] = (
+            round(100.0 * (n - int(tt["violations"])) / n, 2)
+            if n else None)
     consulted = cache["hits"] + cache["misses"]
     cache["hit_rate"] = (round(cache["hits"] / consulted, 4)
                          if consulted else None)
@@ -357,7 +381,8 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         "compile_cache": compile_cache,
         "capacity_inputs": idle_inputs,
         "families": collect_family_throughput(root),
-        "serve": {"hosts": slo_hosts, "totals": slo_totals},
+        "serve": {"hosts": slo_hosts, "totals": slo_totals,
+                  "tenants": tenant_totals},
         # active alert episodes (telemetry/alerts.py): rendered, prom'd
         # as ALERTS gauges and gated by --fail-on-alert; evaluation
         # itself belongs to the in-process engines and vft-alert
@@ -760,6 +785,16 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
                              f"violations={slo.get('violations', 0)}"
                              f" attainment={slo.get('attainment_pct')}%")
             lines.append(line)
+    tenants = serve.get("tenants") or {}
+    if tenants:
+        lines.append("== tenants ==")
+        for t, tt in sorted(tenants.items()):
+            line = (f"  {t:<12} requests={tt.get('requests', 0):<6} "
+                    f"violations={tt.get('violations', 0):<4} "
+                    f"rejects={tt.get('rejects', 0)}")
+            if tt.get("attainment_pct") is not None:
+                line += f"  attainment={tt['attainment_pct']}%"
+            lines.append(line)
     return lines
 
 
@@ -829,6 +864,13 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
     g("vft_fleet_serve_requests_total", t["requests"])
     g("vft_fleet_serve_slo_violations_total", t["violations"])
     g("vft_fleet_serve_slo_attainment_pct", t.get("attainment_pct"))
+    for name, tt in sorted((agg["serve"].get("tenants") or {}).items()):
+        g("vft_tenant_requests_total", tt.get("requests", 0), tenant=name)
+        g("vft_tenant_rejects_total", tt.get("rejects", 0), tenant=name)
+        g("vft_tenant_slo_violations_total", tt.get("violations", 0),
+          tenant=name)
+        g("vft_tenant_slo_attainment_pct", tt.get("attainment_pct"),
+          tenant=name)
     for h in agg["serve"]["hosts"]:
         svc = (h["slo"].get("service") or {})
         for p in ("p50", "p95", "p99"):

@@ -82,6 +82,18 @@ scrape endpoint. ``trace=true`` additionally runs the Chrome-trace
 recorder homed on the spool, so ``serve.request`` windows land on the
 timeline ``vft-fleet --stitch`` merges across hosts.
 
+**End-to-end deadlines & tenants** (the gateway arc, gateway.py): a
+request may carry an absolute ``deadline``; the server refuses to START
+it past the deadline (claim-time wasted-work guard — zero decode/device
+time burned), stops BETWEEN videos when it expires mid-request (partial
+results kept), and writes a terminal ``expired/{id}.json`` record with
+status ``deadline_exceeded`` — never a ``done/`` response (vft-audit
+holds the two mutually exclusive). Gateway-minted ids
+(``{tenant}-{rid}``) additionally land every answered/rejected/expired
+request in per-tenant tallies (heartbeat ``serve.tenants``; labelled
+``vft_tenant_*_total`` counters) so SLO attainment is per-tenant for
+free.
+
 Run it: ``vft-serve feature_type=resnet spool_dir=/srv/vft ...`` (or
 ``python main.py serve ...``). All family config keys apply; the
 serve-specific keys are ``spool_dir`` (required), ``serve_workers``,
@@ -104,10 +116,21 @@ from typing import Any, Dict, List, Optional
 REQUESTS_DIR = "requests"
 CLAIMED_DIR = "claimed"
 DONE_DIR = "done"
+#: terminal ``deadline_exceeded`` records live HERE, never in ``done/``:
+#: a request that expired has no response — it has an expiry record, and
+#: vft-audit holds the two directories mutually exclusive per request id
+EXPIRED_DIR = "expired"
 
 #: request/response schema identifiers
 REQUEST_SCHEMA = "vft.serve_request/1"
 RESPONSE_SCHEMA = "vft.serve_response/1"
+
+
+def tenant_of_request_id(request_id: Optional[str]) -> Optional[str]:
+    """``{tenant}-{rid}`` gateway-minted ids -> tenant; plain spool ids
+    -> None (delegates to telemetry/context.py, the single parser)."""
+    from .telemetry.context import tenant_of
+    return tenant_of(request_id)
 
 
 # -- client side -------------------------------------------------------------
@@ -115,7 +138,7 @@ RESPONSE_SCHEMA = "vft.serve_response/1"
 def spool_paths(spool_dir: str) -> Dict[str, str]:
     root = str(spool_dir)
     return {name: os.path.join(root, name)
-            for name in (REQUESTS_DIR, CLAIMED_DIR, DONE_DIR)}
+            for name in (REQUESTS_DIR, CLAIMED_DIR, DONE_DIR, EXPIRED_DIR)}
 
 
 def ensure_spool(spool_dir: str) -> None:
@@ -124,15 +147,25 @@ def ensure_spool(spool_dir: str) -> None:
 
 
 def submit_request(spool_dir: str, video_paths: List[str],
-                   request_id: Optional[str] = None) -> str:
+                   request_id: Optional[str] = None,
+                   deadline: Optional[float] = None) -> str:
     """Drop one request into the spool (atomic: temp + rename INTO
     ``requests/``, so the server can never claim a half-written file);
-    returns the request id."""
+    returns the request id.
+
+    ``deadline`` is an absolute unix time past which the request is
+    worthless to its caller: the server refuses to START it past the
+    deadline (claim-time check), stops BETWEEN videos when it passes
+    mid-request, and writes a terminal ``expired/`` record either way —
+    the end-to-end deadline contract the gateway stamps from the
+    client's ``timeout_s`` (gateway.py; docs/serving.md)."""
     ensure_spool(spool_dir)
     rid = request_id or uuid.uuid4().hex[:12]
     req = {"schema": REQUEST_SCHEMA, "id": rid,
            "video_paths": [str(v) for v in video_paths],
            "time": round(time.time(), 3)}
+    if deadline is not None:
+        req["deadline"] = round(float(deadline), 3)
     final = os.path.join(spool_dir, REQUESTS_DIR, f"{rid}.json")
     tmp = os.path.join(spool_dir, f".{rid}.json.tmp")
     try:
@@ -163,15 +196,32 @@ def read_response(spool_dir: str, request_id: str) -> Optional[dict]:
         return None
 
 
+def read_terminal(spool_dir: str, request_id: str) -> Optional[dict]:
+    """The request's terminal record, whichever directory holds it: the
+    ``done/`` response, or the ``expired/`` deadline record (status
+    ``deadline_exceeded``). None while the request is still open."""
+    resp = read_response(spool_dir, request_id)
+    if resp is not None:
+        return resp
+    path = os.path.join(spool_dir, EXPIRED_DIR, f"{request_id}.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def wait_response(spool_dir: str, request_id: str,
                   timeout_s: float = 300.0,
                   poll_s: float = 0.1) -> dict:
-    """Block until the response for ``request_id`` lands (or raise
-    TimeoutError). Polling a local/shared filesystem is the protocol —
-    clients need nothing but the spool mount."""
+    """Block until the terminal record for ``request_id`` lands (or
+    raise TimeoutError) — a ``done/`` response, or the ``expired/``
+    deadline record for a request whose deadline passed. Polling a
+    local/shared filesystem is the protocol — clients need nothing but
+    the spool mount."""
     deadline = time.monotonic() + float(timeout_s)
     while True:
-        resp = read_response(spool_dir, request_id)
+        resp = read_terminal(spool_dir, request_id)
         if resp is not None:
             return resp
         if time.monotonic() > deadline:
@@ -192,6 +242,11 @@ def server_state(spool_dir: str) -> Dict[str, Any]:
             with open(p, encoding="utf-8") as f:
                 hb = json.load(f)
         except (OSError, ValueError):
+            continue
+        if "serve" not in hb:
+            # the gateway heartbeats on the same spool (gateway.py) but
+            # carries no serve section — readiness is about SERVERS, so
+            # its liveness must never masquerade as a backend verdict
             continue
         if best is None or float(hb.get("time", 0)) > \
                 float(best.get("time", 0)):
@@ -237,7 +292,12 @@ class ServeLoop:
         self._state = "warming"
         self._state_lock = threading.Lock()
         self._tallies = {"done": 0, "partial": 0, "failed": 0,
-                         "rejected": 0}
+                         "rejected": 0, "deadline_exceeded": 0}
+        # per-tenant request/violation/reject tallies (gateway-minted
+        # ids carry a tenant prefix, telemetry/context.py tenant_of):
+        # published in the heartbeat serve section and rolled fleet-wide
+        # by vft-fleet --prom as vft_tenant_*_total{tenant}
+        self._tenants: Dict[str, Dict[str, int]] = {}
         self._inflight = 0
         self._inflight_rids: set = set()
         # SLO accounting: the latency *distributions* live in the
@@ -364,6 +424,9 @@ class ServeLoop:
                 "max_pending": self.max_pending,
                 "requests": dict(self._tallies),
             }
+            if self._tenants:
+                section["tenants"] = {t: dict(v) for t, v
+                                      in sorted(self._tenants.items())}
         if lat:
             section["last_latency_s"] = round(lat[-1], 3)
             section["mean_latency_s"] = round(sum(lat) / len(lat), 3)
@@ -386,10 +449,26 @@ class ServeLoop:
         }
         return section
 
-    def _account_request(self, wait_s: float, service_s: float) -> bool:
+    def _tenant_bump(self, tenant: Optional[str], key: str) -> None:
+        """One per-tenant tally + its labelled registry counter; a
+        no-op for untenanted (spool-direct) request ids."""
+        if not tenant:
+            return
+        with self._state_lock:
+            t = self._tenants.setdefault(
+                tenant, {"requests": 0, "violations": 0, "rejects": 0})
+            t[key] += 1
+        name = {"requests": "vft_tenant_requests_total",
+                "violations": "vft_tenant_slo_violations_total",
+                "rejects": "vft_tenant_rejects_total"}[key]
+        self.recorder.registry.counter(name, tenant=tenant).inc()
+
+    def _account_request(self, wait_s: float, service_s: float,
+                         tenant: Optional[str] = None) -> bool:
         """Fold one answered request into the SLO state: both splits into
         their histograms, the recent window, and — when ``serve_slo_s``
         is set — the violation counter when wait+service exceeds it.
+        Gateway-minted ids additionally land in the per-tenant tallies.
         Returns True when this request violated the SLO."""
         from .telemetry.metrics import LATENCY_BUCKETS
         reg = self.recorder.registry
@@ -406,6 +485,9 @@ class ServeLoop:
                 self._slo_violations += 1
         if violated:
             reg.counter("vft_serve_slo_violations_total").inc()
+        self._tenant_bump(tenant, "requests")
+        if violated:
+            self._tenant_bump(tenant, "violations")
         return violated
 
     def _pending_count(self) -> int:
@@ -425,12 +507,58 @@ class ServeLoop:
             pass
 
     # -- request processing ------------------------------------------------
-    def _respond(self, rid: str, payload: dict) -> None:
+    def _respond(self, rid: str, payload: dict) -> bool:
+        """Write the ``done/`` response atomically; returns False when
+        the write was LOST (the injected ``spool.respond`` drop — a
+        crashed NFS write, a dying server). Callers must treat False as
+        \"the requester will never hear us\": requeue the claim so a
+        later pass (or sibling) answers, instead of silently swallowing
+        the request."""
         from .telemetry import jsonl
+        from .utils import inject
+        fault = inject.fire("spool.respond", request=rid)
+        if fault is not None and fault.kind == "drop":
+            return False
         payload = {"schema": RESPONSE_SCHEMA, "id": rid,
                    "time": round(time.time(), 3), **payload}
         jsonl.write_json_atomic(
             os.path.join(self.paths[DONE_DIR], f"{rid}.json"), payload)
+        return True
+
+    def _expire(self, rid: str, req: dict, claimed_path: str,
+                statuses: Dict[str, Dict[str, str]], where: str) -> None:
+        """Terminal ``deadline_exceeded``: write the ``expired/`` record
+        (NEVER a ``done/`` response — vft-audit holds the two mutually
+        exclusive), count it, and release the claim. ``statuses`` holds
+        whatever videos finished before the deadline passed
+        (``where="claim"`` means none — the wasted-work guard fired
+        before any decode/device time burned)."""
+        from .telemetry import jsonl
+        tenant = tenant_of_request_id(rid)
+        rec = {"schema": RESPONSE_SCHEMA, "id": rid,
+               "status": "deadline_exceeded",
+               "time": round(time.time(), 3),
+               "deadline": req.get("deadline"),
+               "expired_at": where,
+               "videos": statuses,
+               "processed": len(statuses)}
+        if tenant:
+            rec["tenant"] = tenant
+        jsonl.write_json_atomic(
+            os.path.join(self.paths[EXPIRED_DIR], f"{rid}.json"), rec)
+        from . import telemetry
+        telemetry.inc("vft_serve_deadline_exceeded_total")
+        with self._state_lock:
+            self._tallies["deadline_exceeded"] += 1
+        self._tenant_bump(tenant, "requests")
+        self._tenant_bump(tenant, "violations")
+        try:
+            os.unlink(claimed_path)
+        except OSError:
+            pass
+        print(f"vft-serve: request {rid} deadline exceeded at {where} "
+              f"({len(statuses)} video(s) finished before expiry)",
+              file=sys.stderr)
 
     def _run_one_video(self, video_path: str) -> Dict[str, str]:
         """One video through the warm extractor(s); returns
@@ -463,7 +591,17 @@ class ServeLoop:
             os.unlink(claimed_path)
             return
         wait_s = max(0.0, time.time() - float(req.get("time") or time.time()))
+        deadline = req.get("deadline")
+        deadline = float(deadline) if deadline is not None else None
+        if deadline is not None and time.time() >= deadline:
+            # wasted-work guard: the request expired while QUEUED — the
+            # caller stopped waiting, so cancel at claim time, before any
+            # decode/device second burns (vft-audit pins zero spans for
+            # claim-expired requests)
+            self._expire(rid, req, claimed_path, {}, "claim")
+            return
         statuses: Dict[str, Dict[str, str]] = {}
+        expired = False
         from .telemetry.context import use_request
         with self._state_lock:
             self._inflight_rids.add(rid)
@@ -480,6 +618,12 @@ class ServeLoop:
                 # their clips into shared device groups
                 # (parallel/packer.py)
                 for v in videos:
+                    # deadline re-check BETWEEN videos: expiry mid-request
+                    # stops before the next decode, keeping whatever
+                    # partial results already landed
+                    if deadline is not None and time.time() >= deadline:
+                        expired = True
+                        break
                     if self._stop.is_set():
                         statuses[v] = {f: "dropped" for f in self.families}
                         continue
@@ -493,10 +637,18 @@ class ServeLoop:
         finally:
             with self._state_lock:
                 self._inflight_rids.discard(rid)
+        # the deadline also gates the RESPONSE: a request that finished
+        # its last video past the deadline still expires — the caller is
+        # gone, and done/ vs expired/ stay mutually exclusive
+        if deadline is not None and time.time() >= deadline:
+            expired = True
+        if expired:
+            self._expire(rid, req, claimed_path, statuses,
+                         "mid_request" if statuses else "claim")
+            return
         flat = [s for per in statuses.values() for s in per.values()]
         ok = all(s in ("done", "skipped") for s in flat) and flat
         latency = time.perf_counter() - t0
-        violated = self._account_request(wait_s, latency)
         payload = {
             "status": "done" if ok else "partial",
             "videos": statuses,
@@ -508,8 +660,23 @@ class ServeLoop:
             "compile_cache": compile_cache_summary(mon_before),
         }
         if self.slo_s is not None:
-            payload["slo_violated"] = bool(violated)
-        self._respond(rid, payload)
+            payload["slo_violated"] = bool(wait_s + latency > self.slo_s)
+        if not self._respond(rid, payload):
+            # the response write was LOST (injected spool.respond drop /
+            # a dying store): requeue the claim so a later pass answers —
+            # idempotent re-serving is cheap (sink skip-if-exists + the
+            # content-addressed cache), and accounting happens only on
+            # the pass whose response actually lands
+            try:
+                os.rename(claimed_path, os.path.join(
+                    self.paths[REQUESTS_DIR], f"{rid}.json"))
+            except OSError:
+                pass
+            print(f"vft-serve: response write for {rid} lost — requeued",
+                  file=sys.stderr)
+            return
+        self._account_request(wait_s, latency,
+                              tenant=tenant_of_request_id(rid))
         with self._state_lock:
             self._tallies["done" if ok else "partial"] += 1
         try:
@@ -635,12 +802,20 @@ class ServeLoop:
             except OSError:
                 continue
             rid = name[:-len(".json")]
-            self._respond(rid, {
-                "status": "rejected",
-                "error": f"server backlog over serve_max_pending="
-                         f"{self.max_pending}; retry later"})
+            if not self._respond(rid, {
+                    "status": "rejected",
+                    "error": f"server backlog over serve_max_pending="
+                             f"{self.max_pending}; retry later"}):
+                # lost rejection write: put the request back — a silent
+                # drop would strand the caller with no terminal record
+                try:
+                    os.rename(dst, src)
+                except OSError:
+                    pass
+                continue
             with self._state_lock:
                 self._tallies["rejected"] += 1
+            self._tenant_bump(tenant_of_request_id(rid), "rejects")
             try:
                 os.unlink(dst)
             except OSError:

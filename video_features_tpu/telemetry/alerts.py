@@ -155,21 +155,33 @@ def _rule_slo_burn(obs: dict, cfg: AlertConfig) -> List[dict]:
     service histograms) divided by the error budget. Fires only when
     BOTH the short and the long window burn >= threshold — fast enough
     to catch a real burn inside short_window_s, damped enough that one
-    slow request against a quiet hour stays silent."""
+    slow request against a quiet hour stays silent.
+
+    With per-tenant tallies retained (the gateway arc — heartbeat
+    ``serve.tenants``, sampled by telemetry/history.py), the same
+    two-window test additionally runs per tenant, scoped
+    ``{host}/tenant={name}`` — one noisy tenant burning ITS budget
+    pages as that tenant, not as the host."""
     out: List[dict] = []
     now = obs["time"]
     budget = max(1e-6, 1.0 - cfg.slo_target_pct / 100.0)
-    for host, samples in sorted(obs["history"].items()):
-        short = history.window_rate(samples, "slo.violations",
-                                    "slo.requests", now,
+
+    def burn(samples, num_path: str, den_path: str):
+        short = history.window_rate(samples, num_path, den_path, now,
                                     cfg.short_window_s)
         if short is None or short[1] < cfg.min_requests:
-            continue
-        long_ = history.window_rate(samples, "slo.violations",
-                                    "slo.requests", now,
+            return None
+        long_ = history.window_rate(samples, num_path, den_path, now,
                                     cfg.long_window_s) or short
         burn_s, burn_l = short[2] / budget, long_[2] / budget
         if burn_s >= cfg.burn_threshold and burn_l >= cfg.burn_threshold:
+            return short, burn_s, burn_l
+        return None
+
+    for host, samples in sorted(obs["history"].items()):
+        hit = burn(samples, "slo.violations", "slo.requests")
+        if hit is not None:
+            short, burn_s, burn_l = hit
             out.append(_finding(
                 host,
                 f"SLO burn rate {burn_s:.2f}x budget over "
@@ -177,6 +189,19 @@ def _rule_slo_burn(obs: dict, cfg: AlertConfig) -> List[dict]:
                 f"{int(short[1])} requests violating; long window "
                 f"{burn_l:.2f}x)",
                 value=burn_s, threshold=cfg.burn_threshold))
+        tenants = (samples[-1].get("tenants") or {}) if samples else {}
+        for t in sorted(tenants):
+            hit = burn(samples, f"tenants.{t}.violations",
+                       f"tenants.{t}.requests")
+            if hit is not None:
+                short, burn_s, burn_l = hit
+                out.append(_finding(
+                    f"{host}/tenant={t}",
+                    f"tenant {t}: SLO burn rate {burn_s:.2f}x budget "
+                    f"over {cfg.short_window_s:.0f}s ({int(short[0])}/"
+                    f"{int(short[1])} requests violating; long window "
+                    f"{burn_l:.2f}x)",
+                    value=burn_s, threshold=cfg.burn_threshold))
     return out
 
 

@@ -55,6 +55,28 @@ def current_request_id() -> Optional[str]:
     return getattr(_tls, "request_id", None)
 
 
+def tenant_of(request_id: Optional[str]) -> Optional[str]:
+    """The tenant component of a gateway-minted request id.
+
+    The gateway (gateway.py) mints ids as ``{tenant}-{rid}`` — tenant
+    names are ``[a-z0-9_]+`` (dash-free, enforced at tenant-table load)
+    and the random suffix is dash-free hex, so the first ``-`` splits
+    unambiguously. Spool-direct clients use plain ``uuid4().hex`` ids
+    with no dash: those (and None) return None — the single-implicit-
+    tenant world keeps working untouched."""
+    if not request_id:
+        return None
+    head, sep, rest = str(request_id).partition("-")
+    return head if sep and head and rest else None
+
+
+def current_tenant() -> Optional[str]:
+    """Tenant of the request installed on THIS thread, if any — how the
+    feature cache's ``cache_scope=tenant`` keys entries per tenant
+    without any plumbing through the extractor stack."""
+    return tenant_of(current_request_id())
+
+
 @contextmanager
 def use_request(request_id: Optional[str]) -> Iterator[None]:
     """Install ``request_id`` thread-locally for a block — serve.py

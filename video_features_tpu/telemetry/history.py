@@ -135,6 +135,16 @@ def sample_from_heartbeat(hb: dict,
             "violations": int(slo.get("violations") or 0),
         }
         sample["serve_pending"] = int(serve.get("pending") or 0)
+        tens = serve.get("tenants")
+        if isinstance(tens, dict) and tens:
+            # per-tenant cumulative counters: what the tenant-scoped
+            # SLO burn windows diff (telemetry/alerts.py). Tenant names
+            # are [a-z0-9_]+ (gateway.py), so the dotted-path readers
+            # (`_field`) can address them safely
+            sample["tenants"] = {
+                str(t): {"requests": int(v.get("requests") or 0),
+                         "violations": int(v.get("violations") or 0)}
+                for t, v in tens.items()}
     rf = hb.get("roofline") or {}
     fams = rf.get("families") if isinstance(rf, dict) else None
     if fams:

@@ -12,8 +12,10 @@ seeds and any failing seed replays exactly from its recorded plan
 
 **Sites** (:data:`SITES`) are named chokepoints threaded through the
 durability surface — decode reads, the three legs of the atomic sink
-write, cache store/lookup, queue claim/steal, the serve spool claim, the
-heartbeat tick, and a kill-self site in the per-video attempt loop. A
+write, cache store/lookup, queue claim/steal, the serve spool claim and
+response write, the gateway's client-body read and spool submit
+(gateway.py), the heartbeat tick, and a kill-self site in the per-video
+attempt loop. A
 site costs ONE module-global read when injection is off (the
 telemetry/trace.py discipline): ``fire(site)`` returns ``None``
 immediately, and per-frame call sites additionally hold the active plan
@@ -37,10 +39,16 @@ rule is ``<site>=<fault>@<trigger>``:
   ``error``   raise ``RuntimeError`` — a generic software fault
   ``torn``    ``sink.tmp_write``: write a truncated prefix, then raise
               EIO; ``cache.lookup``: truncate the stored entry so
-              verify-before-trust must catch it
-  ``drop``    rename/steal sites: the operation is lost (site-specific)
+              verify-before-trust must catch it; ``gateway.read``: the
+              client connection dies mid-body (short read)
+  ``drop``    rename/steal/submit/respond sites: the operation is lost
+              (site-specific — a dropped spool submit or response is a
+              silently lost write the deadline/requeue machinery must
+              absorb)
   ``skew``    ``queue.claim``: stamp an already-expired lease deadline
   ``freeze``  ``heartbeat.tick``: silently skip the tick (host looks dead)
+  ``stall``   ``gateway.read``: a slow client — the body read pauses
+              mid-stream (the call site sleeps, then continues)
   ``kill``    ``os.kill(getpid(), SIGKILL)`` — no drain, no final heartbeat
   ==========  ==============================================================
 
@@ -84,6 +92,9 @@ SITES = (
     "queue.steal_staging",  # parallel/queue.py WorkQueue._requeue, between
                             # the staging rename and the pending re-publish
     "spool.claim",          # serve.py ServeLoop._claim_next
+    "spool.respond",        # serve.py ServeLoop._respond, pre-write
+    "gateway.read",         # gateway.py _read_body (client upload/body)
+    "gateway.spool_submit",  # gateway.py _submit_to_spool, pre-rename
     "heartbeat.tick",       # telemetry/heartbeat.py HeartbeatThread._run
     "worker.kill",          # utils/sinks.py safe_extract, per attempt
 )
@@ -98,17 +109,19 @@ _RAISE_ERRNO = {
 }
 
 #: behavioral faults: ``fire`` returns them for the call site to apply
-_BEHAVIORAL = ("torn", "drop", "skew", "freeze")
+_BEHAVIORAL = ("torn", "drop", "skew", "freeze", "stall")
 
 FAULT_KINDS = tuple(_RAISE_ERRNO) + _BEHAVIORAL + ("kill",)
 
 #: which behavioral kinds make sense where — parse-time validation, so a
 #: plan that asks for ``skew`` at a sink fails at launch, not mid-run
 _BEHAVIORAL_SITES = {
-    "torn": ("sink.tmp_write", "cache.lookup"),
-    "drop": ("sink.rename", "queue.steal_staging"),
+    "torn": ("sink.tmp_write", "cache.lookup", "gateway.read"),
+    "drop": ("sink.rename", "queue.steal_staging", "gateway.spool_submit",
+             "spool.respond"),
     "skew": ("queue.claim",),
     "freeze": ("heartbeat.tick",),
+    "stall": ("gateway.read",),
 }
 
 

@@ -75,7 +75,8 @@ NON_SEMANTIC_KEYS = frozenset({
     # how work is scheduled, observed and retried
     "video_workers", "decode_workers", "decode_depth", "video_decode",
     "fanout_depth", "cross_video_batching", "clip_batch_size",
-    "batch_size", "mesh_devices", "distributed",
+    "batch_size", "flow_stack_batch", "model_parallel",
+    "mesh_devices", "distributed",
     "telemetry", "metrics_interval_s", "trace", "health", "roofline",
     "history", "alerts",
     "profile", "profile_trace_dir", "compilation_cache_dir",
@@ -106,6 +107,35 @@ NON_SEMANTIC_KEYS = frozenset({
     # sink format changes the FILE, not the feature values; entries store
     # arrays and are written through whichever sink the run uses
     "on_extraction", "show_pred",
+})
+
+#: config keys that DO bear on feature values — they stay in the
+#: fingerprint, and the choice is now explicit: ``vft-lint`` rule VFT001
+#: fails the build when a key in any family YAML (or read by a
+#: validator) is in neither set, which is exactly how every one of
+#: PRs 9/11/13/14 almost re-introduced the cache-poisoning hazard this
+#: pair of sets exists to prevent. When adding a config key, ask "can
+#: two runs that differ only in this key produce different features?" —
+#: yes -> here, no -> NON_SEMANTIC_KEYS above.
+SEMANTIC_KEYS = frozenset({
+    # what network, on which backend, at what precision
+    "feature_type", "model_name", "device", "precision",
+    "weights_path", "allow_random_weights",
+    # which frames reach it
+    "extraction_fps", "extraction_total", "fps_mode",
+    # how pixels are prepared (resolved resize/ingest overlay included)
+    "resize", "ingest", "side_size", "resize_to_smaller_edge",
+    # clip windowing (value-bearing: changes the stacks the net sees)
+    "stack_size", "step_size", "streams",
+    # flow-family knobs (iteration counts and flow nets change outputs)
+    "flow_type", "flow_iters", "flow_weights_path",
+    "flow_model_weights_path", "iters", "finetuned_on",
+    # kernel dispatch (implementations are near- but not bit-identical)
+    "corr_lookup_impl", "fuse_convc1", "vision_attn",
+    # CLIP text side + prediction rendering inputs
+    "bpe_path", "pred_texts",
+    # VGGish post-processing
+    "frontend", "postprocess", "pca_weights_path",
 })
 
 _sha_lock = threading.Lock()

@@ -24,6 +24,48 @@ import yaml
 
 _CONFIG_DIR = Path(__file__).resolve().parent / "configs"
 
+#: validated config keys that legitimately appear in only SOME family
+#: YAMLs — family-specific defaults (flow nets have iteration counts,
+#: clip-stack families have windowing, CLIP has a text side). ``vft-lint``
+#: rule VFT002 requires every validator-read key to be carried by ALL
+#: eight YAMLs unless it is declared here (or in LAUNCH_KEYS below):
+#: a key that is neither is a default nobody documented.
+OPTIONAL_KEYS = frozenset({
+    "batch_size", "bpe_path", "clip_batch_size", "corr_lookup_impl",
+    "extraction_fps", "extraction_total", "finetuned_on", "flow_iters",
+    "flow_model_weights_path", "flow_stack_batch", "flow_type",
+    "flow_weights_path", "fps_mode", "frontend", "fuse_convc1", "ingest",
+    "iters", "model_name", "model_parallel", "pca_weights_path",
+    "postprocess", "pred_texts", "resize", "resize_to_smaller_edge",
+    "side_size", "stack_size", "step_size", "streams", "vision_attn",
+})
+
+#: launch-time keys that never ride a family YAML: serve/gateway spool
+#: plumbing passed on the vft-serve/vft-gateway command line, and expert
+#: decode-pipeline knobs that are deliberately undocumented defaults.
+#: Declared so VFT002 can tell "launch-only by design" from "typo'd key
+#: nobody validates".
+LAUNCH_KEYS = frozenset({
+    # profiling hooks (cli.py)
+    "profile", "profile_trace_dir",
+    # expert decode-pipeline knobs (extractors/base.py, multi.py)
+    "video_decode", "decode_workers", "decode_depth", "fanout_depth",
+    "cross_video_batching",
+    # vft-serve launch keys (serve.py; serve_slo_s rides the YAMLs)
+    "spool_dir", "serve_max_pending", "serve_poll_interval_s",
+    "serve_idle_exit_s", "serve_max_requests", "serve_workers",
+    "serve_warmup_video",
+    # vft-gateway launch keys (gateway.py validate_gateway_args)
+    "gateway_tenants", "gateway_port", "gateway_host",
+    "gateway_max_queued", "gateway_spool_bound", "gateway_max_body_mb",
+    "gateway_poll_interval_s", "gateway_expire_grace_s",
+    "gateway_default_timeout_s",
+})
+
+#: removed reference flags: accepted, warned about and deleted by
+#: sanity_check — exempt from every other key contract.
+REMOVED_KEYS = frozenset({"device_ids"})
+
 
 class Config(dict):
     """A dict with attribute access, nesting-aware, YAML-serializable.

@@ -872,9 +872,15 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
         g("vft_tenant_slo_attainment_pct", tt.get("attainment_pct"),
           tenant=name)
     for h in agg["serve"]["hosts"]:
+        # both splits of the per-host SLO block: service alone hid
+        # queue-wait regressions from the prom view (vft-lint VFT005
+        # surfaced the declared-but-never-exported name)
         svc = (h["slo"].get("service") or {})
+        qw = (h["slo"].get("queue_wait") or {})
         for p in ("p50", "p95", "p99"):
             g("vft_fleet_serve_service_seconds", svc.get(p),
+              host_id=h["host_id"], quantile=p)
+            g("vft_fleet_serve_queue_wait_seconds", qw.get(p),
               host_id=h["host_id"], quantile=p)
     if agg.get("alerts"):
         # ALERTS{alertname, alertstate, severity, scope} 1 — the exact
@@ -994,8 +1000,10 @@ def stitch(root: str, out_path: Optional[str] = None
             "unanchored": [], "aligned": False}}
     merged = stitch_traces(docs)
     out = out_path or os.path.join(str(root), "_trace_fleet.json")
-    with open(out, "w", encoding="utf-8") as f:
-        json.dump(merged, f)
+    from .utils.sinks import _write_bytes_atomic
+    # the stitched trace lands in the shared fleet root: atomic, so a
+    # concurrently-watching Perfetto reader never loads a torn document
+    _write_bytes_atomic(out, json.dumps(merged).encode("utf-8"))
     return out, merged
 
 
@@ -1122,8 +1130,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         agg = aggregate(args.root)
         capacity = planner.observe(agg)
         dump = build_prom_dump(agg, capacity=capacity)
-        with open(args.prom, "w", encoding="utf-8") as f:
-            f.write(prometheus_text(dump))
+        from .utils.sinks import _write_bytes_atomic
+        # the node-exporter textfile collector reads on its own cadence:
+        # the textfile convention is write-temp-then-rename for a reason
+        _write_bytes_atomic(args.prom,
+                            prometheus_text(dump).encode("utf-8"))
         print(f"prometheus textfile: {args.prom} "
               f"({len(dump['series'])} series)")
     if args.stitch is not None:

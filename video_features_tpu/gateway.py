@@ -217,6 +217,10 @@ def validate_gateway_args(args: Dict[str, Any]) -> None:
                              or not 0 <= int(port) <= 65535):
         raise ValueError(f"gateway_port={port!r}: need an int in "
                          "[0, 65535] (0 = ephemeral, tests)")
+    host = args.get("gateway_host")
+    if host is not None and not isinstance(host, str):
+        raise ValueError(f"gateway_host={host!r}: need a bind address "
+                         "string (default 127.0.0.1) or null")
     for key, lo in (("gateway_max_queued", 1), ("gateway_spool_bound", 1),
                     ("gateway_max_body_mb", 1)):
         v = args.get(key)

@@ -751,6 +751,7 @@ def capture_incident(root: str, record: dict,
         def _write(rel: str, text: str) -> None:
             full = os.path.join(bundle, rel)
             os.makedirs(os.path.dirname(full) or bundle, exist_ok=True)
+            # vft-lint: disable=VFT004 — bundle integrity is manifest-hash-based: manifest.json is written LAST over the recorded sha256s, so a torn artifact fails verify_incident instead of being trusted
             with open(full, "w", encoding="utf-8") as f:
                 f.write(text)
             _add(rel)
@@ -990,9 +991,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             break
     if args.prom:
         from .metrics import prometheus_text
+        from ..utils.sinks import _write_bytes_atomic
         dump = {"series": alerts_prom_series(active)}
-        with open(args.prom, "w", encoding="utf-8") as f:
-            f.write(prometheus_text(dump))
+        # textfile-collector convention: rename into place so a
+        # mid-write scrape never parses half an ALERTS series
+        _write_bytes_atomic(args.prom, prometheus_text(dump).encode("utf-8"))
         print(f"prometheus textfile: {args.prom} "
               f"({len(dump['series'])} series)")
     if args.fail_on_firing and any(a["state"] == "firing"

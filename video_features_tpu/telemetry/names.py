@@ -1,0 +1,132 @@
+"""The metric-name registry: every ``vft_*`` series, declared once.
+
+74+ series names flow from emitters (``telemetry/__init__.py`` helpers,
+``recorder.py``, serve/gateway/queue/cache counters) through heartbeat
+sections to renderers (``telemetry_report``, ``vft-fleet``) and two
+Prometheus exports — connected by nothing but string equality. This
+module is the single source of truth ``vft-lint`` rule **VFT005**
+resolves every reference against: an emitter rename that forgets a
+renderer (or a new series that never gets registered) fails the lint at
+review time instead of silently exporting a dead series.
+
+Contract:
+
+  * every string in the package or ``scripts/`` that fully matches
+    ``vft_[a-z0-9_]+`` must be a key here (the lint enforces it);
+  * ``kind`` is the Prometheus semantic type. **counters end in
+    ``_total``** (enforced); fleet-*aggregated* monotonic sums keep the
+    ``_total`` suffix even though ``vft-fleet --prom`` exports them as
+    gauge samples of another process's counters — the suffix names the
+    semantics, the export kind names the transport;
+  * dynamically-built names (``f"vft_fleet_cache_{k}_total"``) must have
+    every expansion declared here — the lint pattern-matches the
+    f-string skeleton against the registry.
+
+This module is import-light on purpose (no deps): emitters and tools may
+import it, but the lint never imports anything — it reads the literal.
+"""
+from __future__ import annotations
+
+#: name -> Prometheus kind ("counter" | "gauge" | "histogram")
+METRICS = {
+    # -- run lifecycle (telemetry/recorder.py) ------------------------------
+    "vft_videos_total": "counter",
+    "vft_video_wall_seconds": "histogram",
+    "vft_video_processed_fps": "histogram",
+    "vft_stage_seconds": "histogram",
+    "vft_videos_per_second": "gauge",
+    "vft_uptime_seconds": "gauge",
+
+    # -- fault tolerance (utils/faults.py, utils/sinks.py) ------------------
+    "vft_failures_total": "counter",
+    "vft_video_retries_total": "counter",
+    "vft_video_recoveries_total": "counter",
+    "vft_decode_demotions_total": "counter",
+    "vft_deadline_expirations_total": "counter",
+    "vft_quarantine_skips_total": "counter",
+
+    # -- shared-decode fan-out (parallel/fanout.py) -------------------------
+    "vft_fanout_queue_depth": "gauge",
+    "vft_fanout_put_blocked_ms_total": "counter",
+    "vft_fanout_get_starved_ms_total": "counter",
+    "vft_fanout_decode_errors_total": "counter",
+
+    # -- output health (telemetry/health.py) --------------------------------
+    "vft_health_nonfinite_total": "counter",
+
+    # -- heartbeat flusher (telemetry/heartbeat.py) -------------------------
+    "vft_heartbeat_tick_errors_total": "counter",
+
+    # -- feature cache (cache.py via extractors/base.py, multi.py) ----------
+    "vft_cache_hit_total": "counter",
+    "vft_cache_miss_total": "counter",
+    "vft_cache_bypass_total": "counter",
+    "vft_cache_store_failures_total": "counter",
+
+    # -- fleet queue (parallel/queue.py) ------------------------------------
+    "vft_fleet_claimed_total": "counter",
+    "vft_fleet_stolen_total": "counter",
+    "vft_fleet_reclaimed_total": "counter",
+    "vft_fleet_requeued_total": "counter",
+    "vft_fleet_quarantined_total": "counter",
+
+    # -- chaos plane (utils/inject.py) --------------------------------------
+    "vft_inject_fired_total": "counter",
+
+    # -- serve mode (serve.py) ----------------------------------------------
+    "vft_serve_queue_wait_seconds": "histogram",
+    "vft_serve_service_seconds": "histogram",
+    "vft_serve_slo_violations_total": "counter",
+    "vft_serve_deadline_exceeded_total": "counter",
+    "vft_serve_reclaimed_total": "counter",
+    "vft_tenant_requests_total": "counter",
+    "vft_tenant_slo_violations_total": "counter",
+    "vft_tenant_rejects_total": "counter",
+
+    # -- gateway ingress (gateway.py) ---------------------------------------
+    "vft_gateway_requests_total": "counter",
+    "vft_gateway_upload_stored_total": "counter",
+    "vft_gateway_upload_dedup_total": "counter",
+
+    # -- fleet aggregator exports (fleet_report.py --prom): gauge samples
+    #    of the fleet-wide roll-up; *_total names are sums of the
+    #    per-host counters above and keep counter semantics
+    "vft_fleet_hosts": "gauge",
+    "vft_fleet_videos_done": "gauge",
+    "vft_fleet_videos_per_s": "gauge",
+    "vft_fleet_straggler": "gauge",
+    "vft_fleet_queue_items": "gauge",
+    "vft_fleet_cache_hits_total": "counter",
+    "vft_fleet_cache_misses_total": "counter",
+    "vft_fleet_cache_bypasses_total": "counter",
+    "vft_fleet_cache_hit_rate": "gauge",
+    "vft_fleet_compile_cache_hits_total": "counter",
+    "vft_fleet_compile_cache_misses_total": "counter",
+    "vft_fleet_compile_cache_hit_rate": "gauge",
+    "vft_fleet_compile_cache_warm_hosts": "gauge",
+    "vft_fleet_capacity_recommendation": "gauge",
+    "vft_fleet_capacity_pressure": "gauge",
+    "vft_fleet_capacity_pending_per_host": "gauge",
+    "vft_fleet_capacity_idle_share": "gauge",
+    "vft_fleet_family_done": "gauge",
+    "vft_fleet_family_errors": "gauge",
+    "vft_fleet_family_s_per_video": "gauge",
+    "vft_fleet_serve_requests_total": "counter",
+    "vft_fleet_serve_slo_violations_total": "counter",
+    "vft_fleet_serve_slo_attainment_pct": "gauge",
+    "vft_fleet_serve_service_seconds": "gauge",
+    "vft_fleet_serve_queue_wait_seconds": "gauge",
+    "vft_tenant_slo_attainment_pct": "gauge",
+
+    # -- roofline observatory (telemetry/roofline.py via vft-fleet) ---------
+    "vft_roofline_mfu": "gauge",
+    "vft_roofline_effective_tflops": "gauge",
+    "vft_roofline_dispatches_total": "counter",
+    "vft_roofline_peak_tflops": "gauge",
+}
+
+
+def kind_of(name: str) -> str:
+    """The declared kind, or raise — emitters may use this to assert a
+    name is registered before first emission (tests do)."""
+    return METRICS[name]

@@ -110,7 +110,12 @@ def write_numpy(fpath, value, want_digest: bool = False
     # worker can never leave a half-written feature file behind
     if native.write_npy_atomic(fpath, value):
         return None
-    np.save(fpath, value)
+    # native writer unavailable (no compiler on this host): the Python
+    # atomic path is byte-identical (pinned by tests/test_sinks.py) — a
+    # raw np.save here was the one non-atomic .npy fallback left
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(value))
+    _write_bytes_atomic(fpath, buf.getvalue())
     return None
 
 

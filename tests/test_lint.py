@@ -1,0 +1,551 @@
+"""vft-lint: per-rule fire/clean fixtures + engine contracts.
+
+Every rule gets two proofs on a synthetic mini-repo: it FIRES on a
+seeded violation and stays QUIET once the violation is fixed the way
+the finding message says to fix it. Engine contracts (suppressions,
+the unreasoned-suppression meta-warning, baseline grandfathering,
+the --json schema) are pinned separately, and the final test pins the
+real tree: the landed repository lints clean above the committed
+baseline — the acceptance criterion of the pass itself.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu.lint import engine
+from video_features_tpu.lint.engine import run_lint
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# -- fixture mini-repo -------------------------------------------------------
+
+def _w(root: Path, rel: str, text: str) -> None:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+
+
+def _wj(root: Path, rel: str, doc) -> None:
+    _w(root, rel, json.dumps(doc, indent=1))
+
+
+def make_repo(tmp_path: Path) -> Path:
+    """A minimal, fully-consistent repo the linter passes clean. Tests
+    seed one violation each by rewriting a single file."""
+    root = tmp_path / "repo"
+    pkg = "video_features_tpu"
+
+    _w(root, f"{pkg}/__init__.py", "")
+    _w(root, f"{pkg}/utils/__init__.py", "")
+    _w(root, f"{pkg}/telemetry/__init__.py", "")
+    _w(root, f"{pkg}/parallel/__init__.py", "")
+
+    for fam, extra in (("a", "alpha: 1\n"), ("b", "")):
+        _w(root, f"{pkg}/configs/{fam}.yml", f"""\
+            feature_type: '{fam}'
+            device: 'cpu'
+            cache: false
+            output_path: './out'
+            tmp_path: './tmp'
+            {extra}""")
+
+    _w(root, f"{pkg}/config.py", """\
+        OPTIONAL_KEYS = frozenset({"alpha"})
+        LAUNCH_KEYS = frozenset({"spool_dir"})
+        REMOVED_KEYS = frozenset({"device_ids"})
+
+
+        def sanity_check(args):
+            if "device_ids" in args:
+                del args["device_ids"]
+            assert args.feature_type
+            args.get("device")
+            args.get("alpha")
+            args.get("cache")
+            args.get("output_path")
+            args.get("tmp_path")
+        """)
+
+    _w(root, f"{pkg}/cache.py", """\
+        NON_SEMANTIC_KEYS = frozenset({
+            "output_path", "tmp_path", "cache", "spool_dir",
+        })
+        SEMANTIC_KEYS = frozenset({
+            "feature_type", "device", "alpha",
+        })
+        """)
+
+    _w(root, f"{pkg}/utils/inject.py", """\
+        SITES = (
+            "sink.write",
+        )
+
+
+        def fire(site, **info):
+            return None
+        """)
+
+    _w(root, f"{pkg}/utils/sinks.py", """\
+        from . import inject
+        from ..telemetry import telemetry
+
+
+        def _write_bytes_atomic(fpath, data):
+            inject.fire("sink.write", path=str(fpath))
+            telemetry.inc("vft_writes_total")
+        """)
+
+    _w(root, f"{pkg}/telemetry/telemetry.py", """\
+        def inc(name, n=1, **labels):
+            pass
+        """)
+
+    _w(root, f"{pkg}/telemetry/names.py", """\
+        METRICS = {
+            "vft_writes_total": "counter",
+        }
+        """)
+
+    _w(root, "docs/chaos.md", """\
+        # chaos
+
+        | Site | Hook |
+        |---|---|
+        | `sink.write` | sinks |
+        """)
+
+    # schema-lockstep contract modules + JSONs (all four pairs)
+    _w(root, f"{pkg}/telemetry/spans.py", """\
+        SCHEMA_VERSION = "vft.video_span/1"
+        STATUSES = ("done", "error")
+        SPAN_FIELDS = ("schema", "status", "video")
+        """)
+    _wj(root, f"{pkg}/telemetry/video_span.schema.json", {
+        "properties": {"schema": {"enum": ["vft.video_span/1"]},
+                       "status": {"enum": ["done", "error"]},
+                       "video": {"type": "string"}},
+        "required": ["schema", "video"],
+        "additionalProperties": False})
+
+    _w(root, f"{pkg}/telemetry/health.py", """\
+        SCHEMA_VERSION = "vft.feature_health/1"
+        HEALTH_FIELDS = ("schema", "video")
+        """)
+    _wj(root, f"{pkg}/telemetry/feature_health.schema.json", {
+        "properties": {"schema": {"enum": ["vft.feature_health/1"]},
+                       "video": {"type": "string"}},
+        "required": ["schema"], "additionalProperties": False})
+
+    _w(root, f"{pkg}/telemetry/alerts.py", """\
+        SCHEMA_VERSION = "vft.alert/1"
+        STATES = ("pending", "firing", "resolved")
+        SEVERITIES = ("page", "ticket")
+        ALERT_FIELDS = ("schema", "state", "severity")
+        """)
+    _wj(root, f"{pkg}/telemetry/alert.schema.json", {
+        "properties": {"schema": {"enum": ["vft.alert/1"]},
+                       "state": {"enum": ["pending", "firing",
+                                          "resolved"]},
+                       "severity": {"enum": ["page", "ticket"]}},
+        "required": ["schema"], "additionalProperties": False})
+
+    _w(root, f"{pkg}/telemetry/roofline.py", """\
+        SCHEMA_VERSION = "vft.roofline/1"
+        VERDICTS = ("compute-bound", "host-bound")
+        ROOFLINE_FIELDS = ("schema", "device", "families")
+        DEVICE_FIELDS = ("platform",)
+        FAMILY_FIELDS = ("programs", "verdict")
+        CARD_FIELDS = ("flops",)
+        """)
+    _wj(root, f"{pkg}/telemetry/roofline.schema.json", {
+        "properties": {
+            "schema": {"enum": ["vft.roofline/1"]},
+            "device": {"properties": {"platform": {"type": "string"}},
+                       "additionalProperties": False},
+            "families": {"additionalProperties": {
+                "properties": {
+                    "programs": {"items": {
+                        "properties": {"flops": {"type": "number"}},
+                        "additionalProperties": False}},
+                    "verdict": {"enum": ["compute-bound", "host-bound",
+                                         None]}},
+                "additionalProperties": False}}},
+        "required": ["schema"], "additionalProperties": False})
+
+    # threaded modules (VFT007 scope): a correctly-locked mutation
+    _w(root, f"{pkg}/serve.py", """\
+        import threading
+
+        _OPEN = {}
+        _LOCK = threading.Lock()
+
+
+        def accept(rid):
+            with _LOCK:
+                _OPEN[rid] = "queued"
+        """)
+    _w(root, f"{pkg}/gateway.py", "")
+    _w(root, f"{pkg}/parallel/queue.py", "")
+    _w(root, f"{pkg}/telemetry/heartbeat.py", "")
+    return root
+
+
+def errors_of(findings, rule=None):
+    return [f for f in findings if f.tier == engine.ERROR
+            and (rule is None or f.rule == rule)]
+
+
+def warns_of(findings, rule=None):
+    return [f for f in findings if f.tier == engine.WARN
+            and (rule is None or f.rule == rule)]
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    return make_repo(tmp_path)
+
+
+# -- the clean fixture -------------------------------------------------------
+
+def test_clean_fixture_passes(repo):
+    findings, suppressed, _ = run_lint(str(repo))
+    assert errors_of(findings) == [], \
+        [f.render() for f in errors_of(findings)]
+    assert suppressed == []
+
+
+# -- VFT001 ------------------------------------------------------------------
+
+def test_vft001_unclassified_key_fires_then_classified_is_quiet(repo):
+    yml = repo / "video_features_tpu/configs/a.yml"
+    yml.write_text(yml.read_text() + "newknob: 3\n")
+    findings, _, _ = run_lint(str(repo), ["VFT001"])
+    msgs = [f.message for f in errors_of(findings, "VFT001")]
+    assert any("'newknob' is unclassified" in m for m in msgs)
+
+    cache = repo / "video_features_tpu/cache.py"
+    cache.write_text(cache.read_text().replace(
+        '"spool_dir",', '"spool_dir", "newknob",'))
+    findings, _, _ = run_lint(str(repo), ["VFT001"])
+    assert errors_of(findings, "VFT001") == []
+
+
+def test_vft001_double_classification_fires(repo):
+    cache = repo / "video_features_tpu/cache.py"
+    cache.write_text(cache.read_text().replace(
+        '"feature_type",', '"feature_type", "cache",'))
+    findings, _, _ = run_lint(str(repo), ["VFT001"])
+    assert any("BOTH" in f.message
+               for f in errors_of(findings, "VFT001"))
+
+
+def test_vft001_stale_classification_warns(repo):
+    cache = repo / "video_features_tpu/cache.py"
+    cache.write_text(cache.read_text().replace(
+        '"alpha",', '"alpha", "ghost_knob",'))
+    findings, _, _ = run_lint(str(repo), ["VFT001"])
+    assert any("stale" in f.message
+               for f in warns_of(findings, "VFT001"))
+
+
+# -- VFT002 ------------------------------------------------------------------
+
+def test_vft002_validated_key_in_no_yaml_fires(repo):
+    cfg = repo / "video_features_tpu/config.py"
+    cfg.write_text(cfg.read_text().replace(
+        'args.get("cache")', 'args.get("cache")\n    args.get("ghost")'))
+    findings, _, _ = run_lint(str(repo), ["VFT002"])
+    msgs = [f.message for f in errors_of(findings, "VFT002")]
+    assert any("validated config key 'ghost'" in m for m in msgs)
+
+
+def test_vft002_partial_yaml_key_needs_optional_declaration(repo):
+    # 'alpha' is only in a.yml; removing it from OPTIONAL_KEYS fires
+    cfg = repo / "video_features_tpu/config.py"
+    cfg.write_text(cfg.read_text().replace(
+        'OPTIONAL_KEYS = frozenset({"alpha"})',
+        'OPTIONAL_KEYS = frozenset({"unused_decl"})'))
+    findings, _, _ = run_lint(str(repo), ["VFT002"])
+    msgs = [f.message for f in errors_of(findings, "VFT002")]
+    assert any("'alpha' appears in only some family YAMLs" in m
+               for m in msgs)
+    # and the stale declaration warns
+    assert any("'unused_decl'" in f.message
+               for f in warns_of(findings, "VFT002"))
+
+
+def test_vft002_undeclared_code_read_fires_then_yaml_fixes(repo):
+    mod = repo / "video_features_tpu/serve.py"
+    mod.write_text(mod.read_text() + "\n\ndef poll(args):\n"
+                   "    return args.get('spool_poll_s')\n")
+    findings, _, _ = run_lint(str(repo), ["VFT002"])
+    msgs = [f.message for f in errors_of(findings, "VFT002")]
+    assert any("'spool_poll_s' is read here but declared nowhere" in m
+               for m in msgs)
+    for fam in ("a", "b"):
+        yml = repo / f"video_features_tpu/configs/{fam}.yml"
+        yml.write_text(yml.read_text() + "spool_poll_s: 0.25\n")
+    findings, _, _ = run_lint(str(repo), ["VFT002"])
+    assert errors_of(findings, "VFT002") == []
+
+
+def test_vft002_argparse_namespace_is_not_a_config(repo):
+    mod = repo / "video_features_tpu/gateway.py"
+    mod.write_text("import argparse\n\n\ndef main(argv):\n"
+                   "    ap = argparse.ArgumentParser()\n"
+                   "    args = ap.parse_args(argv)\n"
+                   "    return args.get('prom'), args.verbose\n")
+    findings, _, _ = run_lint(str(repo), ["VFT002"])
+    assert errors_of(findings, "VFT002") == []
+
+
+# -- VFT003 ------------------------------------------------------------------
+
+def test_vft003_unregistered_site_fires(repo):
+    mod = repo / "video_features_tpu/utils/sinks.py"
+    mod.write_text(mod.read_text().replace(
+        'inject.fire("sink.write"', 'inject.fire("sink.typo"'))
+    findings, _, _ = run_lint(str(repo), ["VFT003"])
+    msgs = [f.message for f in errors_of(findings, "VFT003")]
+    assert any("'sink.typo' is fired here but not registered" in m
+               for m in msgs)
+    # ...and the now-orphaned registered site is dead coverage
+    assert any("'sink.write' has no fire()/check() call site" in m
+               for m in msgs)
+
+
+def test_vft003_missing_doc_row_fires(repo):
+    doc = repo / "docs/chaos.md"
+    doc.write_text("# chaos\n\nno table here\n")
+    findings, _, _ = run_lint(str(repo), ["VFT003"])
+    assert any("no row in the docs/chaos.md site table" in f.message
+               for f in errors_of(findings, "VFT003"))
+
+
+# -- VFT004 ------------------------------------------------------------------
+
+def test_vft004_raw_write_fires_and_suppression_silences(repo):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("import json\n\n\ndef flush(path, doc):\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        json.dump(doc, f)\n")
+    findings, _, _ = run_lint(str(repo), ["VFT004"])
+    assert len(errors_of(findings, "VFT004")) == 1
+
+    mod.write_text("import json\n\n\ndef flush(path, doc):\n"
+                   "    # vft-lint: disable=VFT004 — test fixture reason\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        json.dump(doc, f)\n")
+    findings, suppressed, _ = run_lint(str(repo), ["VFT004"])
+    assert errors_of(findings, "VFT004") == []
+    assert len(suppressed) == 1
+
+
+def test_vft004_np_save_to_path_fires_but_buffer_is_fine(repo):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("import io\nimport numpy as np\n\n\n"
+                   "def a(path, v):\n    np.save(path, v)\n\n\n"
+                   "def b(v):\n    buf = io.BytesIO()\n"
+                   "    np.save(buf, v)\n    return buf.getvalue()\n")
+    findings, _, _ = run_lint(str(repo), ["VFT004"])
+    errs = errors_of(findings, "VFT004")
+    assert len(errs) == 1 and errs[0].line == 6
+
+
+def test_vft004_read_mode_never_fires(repo):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("def load(path):\n"
+                   "    with open(path) as f:\n        return f.read()\n")
+    findings, _, _ = run_lint(str(repo), ["VFT004"])
+    assert errors_of(findings, "VFT004") == []
+
+
+# -- VFT005 ------------------------------------------------------------------
+
+def test_vft005_undeclared_metric_fires_then_registered_quiet(repo):
+    mod = repo / "video_features_tpu/utils/sinks.py"
+    mod.write_text(mod.read_text().replace(
+        'telemetry.inc("vft_writes_total")',
+        'telemetry.inc("vft_writes_total")\n'
+        '    telemetry.inc("vft_mystery_total")'))
+    findings, _, _ = run_lint(str(repo), ["VFT005"])
+    assert any("'vft_mystery_total' is not declared" in f.message
+               for f in errors_of(findings, "VFT005"))
+
+    names = repo / "video_features_tpu/telemetry/names.py"
+    names.write_text(names.read_text().replace(
+        '"vft_writes_total": "counter",',
+        '"vft_writes_total": "counter",\n'
+        '    "vft_mystery_total": "counter",'))
+    findings, _, _ = run_lint(str(repo), ["VFT005"])
+    assert errors_of(findings, "VFT005") == []
+
+
+def test_vft005_counter_naming_and_kind_mismatch(repo):
+    names = repo / "video_features_tpu/telemetry/names.py"
+    names.write_text('METRICS = {\n'
+                     '    "vft_writes_total": "gauge",\n'
+                     '    "vft_bad_counter": "counter",\n'
+                     '}\n')
+    findings, _, _ = run_lint(str(repo), ["VFT005"])
+    msgs = [f.message for f in errors_of(findings, "VFT005")]
+    assert any("'vft_bad_counter' must end in _total" in m for m in msgs)
+    # sinks.py uses .inc() on a now-gauge-declared name
+    assert any("declared a gauge but used via .inc()" in m for m in msgs)
+
+
+def test_vft005_unused_registration_warns(repo):
+    names = repo / "video_features_tpu/telemetry/names.py"
+    names.write_text(names.read_text().replace(
+        '"vft_writes_total": "counter",',
+        '"vft_writes_total": "counter",\n'
+        '    "vft_orphan_total": "counter",'))
+    findings, _, _ = run_lint(str(repo), ["VFT005"])
+    assert any("'vft_orphan_total' is referenced nowhere" in f.message
+               for f in warns_of(findings, "VFT005"))
+
+
+# -- VFT006 ------------------------------------------------------------------
+
+def test_vft006_missing_schema_property_fires(repo):
+    sj = repo / "video_features_tpu/telemetry/video_span.schema.json"
+    doc = json.loads(sj.read_text())
+    del doc["properties"]["video"]
+    doc["required"] = ["schema"]
+    sj.write_text(json.dumps(doc))
+    findings, _, _ = run_lint(str(repo), ["VFT006"])
+    assert any("emitter field 'video' missing from the schema" in f.message
+               for f in errors_of(findings, "VFT006"))
+
+
+def test_vft006_enum_drift_fires(repo):
+    al = repo / "video_features_tpu/telemetry/alerts.py"
+    al.write_text(al.read_text().replace(
+        '("pending", "firing", "resolved")',
+        '("pending", "firing", "resolved", "silenced")'))
+    findings, _, _ = run_lint(str(repo), ["VFT006"])
+    assert any("'state' enum" in f.message
+               for f in errors_of(findings, "VFT006"))
+
+
+def test_vft006_roofline_nested_drift_fires(repo):
+    rf = repo / "video_features_tpu/telemetry/roofline.py"
+    rf.write_text(rf.read_text().replace(
+        'CARD_FIELDS = ("flops",)', 'CARD_FIELDS = ("flops", "bytes")'))
+    findings, _, _ = run_lint(str(repo), ["VFT006"])
+    assert any("roofline.card" in f.message and "'bytes'" in f.message
+               for f in errors_of(findings, "VFT006"))
+
+
+# -- VFT007 ------------------------------------------------------------------
+
+def test_vft007_unlocked_mutation_warns_locked_is_quiet(repo):
+    serve = repo / "video_features_tpu/serve.py"
+    serve.write_text(serve.read_text().replace(
+        '    with _LOCK:\n        _OPEN[rid] = "queued"',
+        '    _OPEN[rid] = "queued"'))
+    findings, _, _ = run_lint(str(repo), ["VFT007"])
+    ws = warns_of(findings, "VFT007")
+    assert len(ws) == 1 and "_OPEN" in ws[0].message
+
+    # the original (locked) fixture is quiet
+    repo2 = make_repo(serve.parents[2] / "again")
+    findings, _, _ = run_lint(str(repo2), ["VFT007"])
+    assert warns_of(findings, "VFT007") == []
+
+
+# -- engine contracts --------------------------------------------------------
+
+def test_unreasoned_suppression_warns_vft000(repo):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("def flush(path, doc):\n"
+                   "    # vft-lint: disable=VFT004\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        f.write(doc)\n")
+    findings, suppressed, _ = run_lint(str(repo))
+    assert len(suppressed) == 1
+    assert any(f.rule == "VFT000" and "without a reason" in f.message
+               for f in warns_of(findings))
+
+
+def test_baseline_grandfathers_then_fails_on_new(repo, tmp_path, capsys):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("def flush(path, doc):\n"
+                   "    with open(path, 'w') as f:\n        f.write(doc)\n")
+    base = tmp_path / "baseline.json"
+    assert engine.main([str(repo), "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+
+    # grandfathered: the old finding no longer gates
+    assert engine.main([str(repo), "--baseline", str(base),
+                       "--fail-on-new"]) == 0
+    capsys.readouterr()
+    # without the baseline it still fails outright
+    assert engine.main([str(repo)]) == 1
+    capsys.readouterr()
+
+    # a NEW violation fails even with the baseline
+    mod.write_text(mod.read_text() +
+                   "\n\ndef flush2(path, doc):\n"
+                   "    with open(path, 'wb') as f:\n        f.write(doc)\n")
+    assert engine.main([str(repo), "--baseline", str(base),
+                       "--fail-on-new"]) == 1
+    out = capsys.readouterr().out
+    assert "(baselined)" in out and "1 new" in out
+
+
+def test_json_output_schema_stable(repo, capsys):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    mod.write_text("def flush(path, doc):\n"
+                   "    with open(path, 'w') as f:\n        f.write(doc)\n")
+    rc = engine.main([str(repo), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["schema"] == "vft.lint/1"
+    assert set(doc["counts"]) == {"errors", "warnings", "suppressed",
+                                  "new_errors", "baselined"}
+    f = [x for x in doc["findings"] if x["rule"] == "VFT004"][0]
+    assert set(f) == {"rule", "tier", "path", "line", "message",
+                      "fingerprint", "new"}
+    assert f["new"] is True and f["tier"] == "error"
+
+
+def test_fingerprint_survives_line_shift(repo):
+    mod = repo / "video_features_tpu/telemetry/heartbeat.py"
+    body = ("def flush(path, doc):\n"
+            "    with open(path, 'w') as f:\n        f.write(doc)\n")
+    mod.write_text(body)
+    f1 = errors_of(run_lint(str(repo), ["VFT004"])[0])[0]
+    mod.write_text("# a comment\n# another\n" + body)
+    f2 = errors_of(run_lint(str(repo), ["VFT004"])[0])[0]
+    assert f1.line != f2.line
+    assert f1.fingerprint == f2.fingerprint
+
+
+# -- the real tree -----------------------------------------------------------
+
+def test_real_tree_lints_clean_above_baseline():
+    findings, _suppressed, elapsed = run_lint(str(REPO_ROOT))
+    baseline_path = REPO_ROOT / ".vft-lint-baseline.json"
+    baseline = engine.load_baseline(str(baseline_path)) \
+        if baseline_path.exists() else set()
+    new = [f for f in findings if f.tier == engine.ERROR
+           and f.fingerprint not in baseline]
+    assert new == [], "the landed tree must lint clean: " + \
+        "; ".join(f.render() for f in new)
+    # the <10s acceptance bound, with slack for loaded CI boxes
+    assert elapsed < 30.0
+
+
+def test_real_tree_suppressions_all_reasoned():
+    findings, _, _ = run_lint(str(REPO_ROOT))
+    unreasoned = [f for f in findings
+                  if f.rule == "VFT000" and "without a reason" in f.message]
+    assert unreasoned == [], [f.render() for f in unreasoned]

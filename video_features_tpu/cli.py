@@ -112,6 +112,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         # `vft-gateway` console script)
         from .gateway import gateway_main
         return gateway_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # contract-aware static analysis: `python main.py lint [--json
+        # --baseline ...]` proves the repo's cross-file invariants in
+        # seconds (lint/; also installed as the `vft-lint` console
+        # script). Exits with the lint verdict.
+        from .lint.engine import main as lint_main
+        raise SystemExit(lint_main(argv[1:]))
     if argv and argv[0] == "warmup":
         # ahead-of-time compile warmup: `python main.py warmup resnet ...`
         # routes to the store populator (compile_cache.py; also installed

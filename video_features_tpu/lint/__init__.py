@@ -1,0 +1,19 @@
+"""`vft-lint`: contract-aware static analysis for this repository.
+
+Fourteen PRs in, the system's correctness rests on cross-file contracts
+that were maintained purely by convention: every new config key must be
+classified against ``cache.py``'s fingerprint sets or it silently
+poisons the content-addressed cache key; every ``inject.fire(site)``
+must name a registered site with chaos-doc coverage; every ``*_FIELDS``
+tuple must stay in lockstep with its checked-in ``*.schema.json``;
+every durable artifact must go through the temp+fsync+rename path. The
+runtime ``scripts/check_*.py`` smokes catch that drift minutes into CI
+— *after* the code already shipped past review. This package proves the
+mechanical halves of those contracts in seconds, at review time, with
+no imports of the package under analysis (pure ``ast`` + YAML + JSON).
+
+Entry points: the ``vft-lint`` console script, ``python main.py lint``,
+or ``python -m video_features_tpu.lint``. See ``docs/static_analysis.md``
+for the rule table and the suppression/baseline workflow.
+"""
+from .engine import main, run_lint  # noqa: F401

@@ -1,0 +1,5 @@
+"""``python -m video_features_tpu.lint`` — same entry as ``vft-lint``."""
+from .engine import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -5,11 +5,11 @@ smoke trips one rule, captures a verified incident bundle, and resolves.
 Fifth sibling of the telemetry/health/trace/roofline gates, for the
 alerting & flight-recorder plane (telemetry/alerts.py). Three halves:
 
-  1. **static**: ``alert.schema.json`` properties == ``ALERT_FIELDS``;
-     ``required`` is a subset; the schema tag / state / severity enums
-     match the module constants; synthetic pending/firing/resolved
-     records validate via the dependency-free validator
-     (telemetry/schema.py).
+  1. **synthetic**: pending/firing/resolved records carry exactly
+     ``ALERT_FIELDS`` and validate via the dependency-free validator
+     (telemetry/schema.py) — the properties/required/enum lockstep
+     with ``alert.schema.json`` is now proven statically by
+     ``vft-lint`` rule **VFT006**.
   2. **dynamic**: a real resnet CPU smoke with ``alerts=true
      history=true`` and a deterministic injected ENOSPC
      (``inject="seed=0;sink.fsync=enospc@n1"``) must fire the
@@ -42,8 +42,7 @@ sys.path.insert(0, str(REPO_ROOT / "scripts"))
 
 from video_features_tpu.telemetry import alerts  # noqa: E402
 from video_features_tpu.telemetry.alerts import (ALERT_FIELDS,  # noqa: E402
-                                                 SEVERITIES, STATES,
-                                                 load_alert_schema,
+                                                 STATES,
                                                  validate_alert,
                                                  verify_incident)
 from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
@@ -52,39 +51,10 @@ SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
 
 
 def check_static() -> List[str]:
+    # (properties/required/state/severity/tag lockstep with
+    # alert.schema.json is vft-lint VFT006's job now)
     errs: List[str] = []
-    try:
-        sch = load_alert_schema()
-    except (OSError, json.JSONDecodeError) as e:
-        return [f"cannot load {alerts.ALERT_SCHEMA_PATH}: "
-                f"{type(e).__name__}: {e}"]
-    props = set(sch.get("properties", {}))
     fields = set(ALERT_FIELDS)
-    if props != fields:
-        only_schema = sorted(props - fields)
-        only_emitter = sorted(fields - props)
-        if only_schema:
-            errs.append(f"schema-only properties (emitter never writes "
-                        f"them): {only_schema}")
-        if only_emitter:
-            errs.append(f"emitter fields missing from schema: "
-                        f"{only_emitter}")
-    missing_req = sorted(set(sch.get("required", [])) - props)
-    if missing_req:
-        errs.append(f"required keys not in properties: {missing_req}")
-    tag = sch.get("properties", {}).get("schema", {}).get("enum")
-    if tag != [alerts.SCHEMA_VERSION]:
-        errs.append(f"schema tag enum {tag} != "
-                    f"[{alerts.SCHEMA_VERSION!r}]")
-    if sch.get("properties", {}).get("state", {}).get("enum") != \
-            list(STATES):
-        errs.append("state enum drifted from telemetry/alerts.py STATES")
-    if sch.get("properties", {}).get("severity", {}).get("enum") != \
-            list(SEVERITIES):
-        errs.append("severity enum drifted from SEVERITIES")
-    if sch.get("additionalProperties", True) is not False:
-        errs.append("schema must set additionalProperties: false "
-                    "(the record contract is closed)")
 
     # synthetic records for every state validate and carry exactly the
     # declared keys
@@ -220,7 +190,7 @@ def main() -> int:
         for e in errs:
             print(f"  - {e}")
         return 1
-    print("alerts schema gate: PASS (schema == ALERT_FIELDS; injected "
+    print("alerts schema gate: PASS (synthetic records validate; injected "
           "FATAL fired failure_spike in-process with a verified "
           "incident bundle, --fail-on-alert tripped then lifted, "
           "one-shot resolution landed; healthy run fired nothing)")

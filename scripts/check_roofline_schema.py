@@ -6,14 +6,12 @@ Fourth sibling of ``check_telemetry_schema.py`` / ``check_trace_schema.py``
 / ``check_health_schema.py``, for the MFU-accounting pillar
 (telemetry/roofline.py). Two halves:
 
-  1. **static**: ``roofline.schema.json`` properties equal the emitter's
-     field lists (``ROOFLINE_FIELDS`` / ``DEVICE_FIELDS`` /
-     ``FAMILY_FIELDS`` / ``CARD_FIELDS``), the verdict enum equals
-     ``VERDICTS`` (+ null), the schema tag matches, and a synthetic
-     observer document (toy jitted program through the real
-     ``DataParallelApply`` dispatch hook) has exactly the declared keys
-     and validates via the dependency-free validator
-     (telemetry/schema.py);
+  1. **synthetic**: a real observer document (toy jitted program
+     through the actual ``DataParallelApply`` dispatch hook) has
+     exactly the declared keys and validates via the dependency-free
+     validator (telemetry/schema.py) — the nested field-list/enum
+     lockstep with ``roofline.schema.json`` is now proven statically by
+     ``vft-lint`` rule **VFT006**;
   2. **dynamic**: a single-family resnet CPU smoke over the vendored
      sample with ``roofline=true telemetry=true`` must write a valid
      ``_roofline.json`` whose resnet family carries cost cards with
@@ -46,53 +44,10 @@ from video_features_tpu.telemetry import schema as tschema  # noqa: E402
 SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
 
 
-def _props_match(sch: dict, fields, label: str) -> List[str]:
-    errs: List[str] = []
-    props = set(sch.get("properties", {}))
-    want = set(fields)
-    if props != want:
-        only_schema = sorted(props - want)
-        only_emitter = sorted(want - props)
-        if only_schema:
-            errs.append(f"{label}: schema-only properties (emitter never "
-                        f"writes them): {only_schema}")
-        if only_emitter:
-            errs.append(f"{label}: emitter fields missing from schema: "
-                        f"{only_emitter}")
-    missing_req = sorted(set(sch.get("required", [])) - props)
-    if missing_req:
-        errs.append(f"{label}: required keys not in properties: "
-                    f"{missing_req}")
-    return errs
-
-
 def check_static() -> List[str]:
+    # (the nested properties/required/enum lockstep with
+    # roofline.schema.json is vft-lint VFT006's job now)
     errs: List[str] = []
-    try:
-        sch = roofline.load_roofline_schema()
-    except (OSError, json.JSONDecodeError) as e:
-        return [f"cannot load {roofline.ROOFLINE_SCHEMA_PATH}: "
-                f"{type(e).__name__}: {e}"]
-    errs += _props_match(sch, roofline.ROOFLINE_FIELDS, "top-level")
-    if sch.get("additionalProperties", True) is not False:
-        errs.append("top-level schema must set additionalProperties: false")
-    tag_enum = sch.get("properties", {}).get("schema", {}).get("enum")
-    if tag_enum != [roofline.SCHEMA_VERSION]:
-        errs.append(f"schema tag enum {tag_enum} != "
-                    f"[{roofline.SCHEMA_VERSION!r}]")
-    dev = sch.get("properties", {}).get("device", {})
-    errs += _props_match(dev, roofline.DEVICE_FIELDS, "device")
-    fam = sch.get("properties", {}).get("families", {}) \
-        .get("additionalProperties", {})
-    errs += _props_match(fam, roofline.FAMILY_FIELDS, "family")
-    card = fam.get("properties", {}).get("programs", {}).get("items", {})
-    errs += _props_match(card, roofline.CARD_FIELDS, "program card")
-    verdict_enum = fam.get("properties", {}).get("verdict", {}).get("enum")
-    if verdict_enum is None or \
-            [v for v in verdict_enum if v is not None] != \
-            list(roofline.VERDICTS):
-        errs.append(f"verdict enum {verdict_enum} != VERDICTS "
-                    f"{list(roofline.VERDICTS)} (+ null)")
 
     # a real emitted document: toy jitted program through the actual
     # DataParallelApply dispatch hook, summarized and validated
@@ -128,7 +83,7 @@ def check_static() -> List[str]:
             errs.append(f"family keys "
                         f"{sorted(set(fam_doc) ^ set(roofline.FAMILY_FIELDS))}"
                         " differ from FAMILY_FIELDS")
-        errs.extend(tschema.validate(doc, sch))
+        errs.extend(tschema.validate(doc, roofline.load_roofline_schema()))
     return errs
 
 

@@ -792,6 +792,58 @@ def bench_alert_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_gc_overhead(families=("resnet", "clip", "s3d"),
+                      n_copies: int = 2) -> dict:
+    """Wall-clock cost of the storage-accounting plane (gc.py
+    GcMonitor) on the same smoke corpus as the other observability
+    ratios. Both arms run ``telemetry=true`` with a 1s heartbeat so the
+    tick machinery is in the baseline; ``on`` adds ``gc=true`` with a
+    quota and ``gc_interval_s=1`` — a full per-plane tree walk plus the
+    vft_gc_* gauge publication on (at least) every heartbeat, the
+    worst-case accounting cadence (production default is 300s). The
+    EVICTION half never runs in-process — that is vft-gc's own process
+    — so this ratio isolates exactly what gc=true costs a run. Budget
+    <= 1.05x, tracked per round like the trace/inject/slo/alert
+    ratios."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the gc bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32", "telemetry=true",
+            "metrics_interval_s=1"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_gc_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_gc{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", [])
+        on = run("on", ["gc=true", "gc_quota_gb=100", "gc_interval_s=1"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_cache(family: str = "resnet", n_copies: int = 3) -> dict:
     """Repeat-content avoidance ratio (ISSUE 7): the SAME corpus run
     twice with ``cache=true`` into a fresh content-addressed store
@@ -2116,6 +2168,29 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: alert-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # storage lifecycle accounting (gc.py): per-plane tree walk + gauge
+    # publication on a worst-case 1s cadence — the accounting half of
+    # vft-gc held to the same <= 1.05x budget, bench-history gated
+    try:
+        go = bench_gc_overhead()
+        metrics.append({
+            "metric": "gc accounting overhead (gc=true vs "
+                      f"telemetry-only, {'+'.join(go['families'])})",
+            "value": go["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": go["off_s"],
+            "on_s": go["on_s"],
+            "note": f"{go['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs, 1s heartbeat in BOTH arms; on = a "
+                    "full per-plane usage walk + vft_gc_* gauges every "
+                    "interval (1s here, 300s production default) — "
+                    "eviction runs in vft-gc's own process, never here "
+                    "(docs/storage.md)",
+        })
+    except Exception as e:
+        print(f"WARNING: gc-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # repeat-content avoidance (cache.py): second pass over the same
     # corpus must be near-pure cache-hit throughput; tracked per round

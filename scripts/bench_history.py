@@ -48,6 +48,19 @@ SCHEMA_VERSION = "vft.bench_history/1"
 HISTORY_FILENAME = "BENCH_history.jsonl"
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
+#: tiered retention for bench rounds — telemetry/history.py's downsample
+#: algorithm with cadences matched to merge-time benching instead of
+#: 30s heartbeats: every round for a month, dailies for half a year,
+#: weeklies for two, nothing past that. Without this the file grows one
+#: record per CI round forever (the same unbounded-growth bug the
+#: heartbeat history already solved — share the fix, don't refix it).
+BENCH_TIERS = ((30 * 86400.0, 0.0),
+               (180 * 86400.0, 86400.0),
+               (730 * 86400.0, 7 * 86400.0))
+
+#: records tolerated before ``append`` auto-compacts
+BENCH_COMPACT_AFTER = 256
+
 
 def default_history_path() -> str:
     return str(REPO_ROOT / HISTORY_FILENAME)
@@ -135,7 +148,42 @@ def append_rounds(path: str, inputs: List[str]) -> int:
         added += 1
     print(f"bench history: {added} round(s) appended to {path} "
           f"({len(seen)} total)")
+    if added and len(load_history(path)) > BENCH_COMPACT_AFTER:
+        compact_history(path)
     return 0
+
+
+def compact_history(path: str, now: Optional[float] = None) -> int:
+    """Rewrite the history through the heartbeat-history downsampler
+    (telemetry/history.py) with bench-cadence tiers. Records carry
+    ``recorded_time``, not ``time`` — shimmed in and stripped back out.
+    Atomic temp+replace; returns the retained count."""
+    from video_features_tpu.telemetry.history import downsample
+    history = load_history(path)
+    shimmed = [{**r, "time": r.get("recorded_time")} for r in history
+               if r.get("recorded_time") is not None]
+    kept = downsample(shimmed, now=now, tiers=BENCH_TIERS)
+    if len(kept) == len(history):
+        return len(history)
+    tmp = path + ".compact.tmp"
+    try:
+        # vft-lint: disable=VFT004 — temp+fsync+os.replace in place (line-oriented rewrite, same discipline as HistoryWriter.compact)
+        with open(tmp, "w", encoding="utf-8") as f:
+            for s in kept:
+                s = {k: v for k, v in s.items() if k != "time"}
+                f.write(json.dumps(s, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    print(f"bench history: compacted {len(history)} -> {len(kept)} "
+          f"round(s) in {path}")
+    return len(kept)
 
 
 # -- regression check -------------------------------------------------------
@@ -232,7 +280,7 @@ def check_regressions(path: str, band: float
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("command", choices=("append", "check"))
+    ap.add_argument("command", choices=("append", "check", "compact"))
     ap.add_argument("inputs", nargs="*",
                     help="append: BENCH_r0N.json snapshots, raw bench "
                          "lines, or '-' for stdin")
@@ -249,6 +297,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.inputs:
             ap.error("append needs at least one input file (or '-')")
         return append_rounds(args.history, args.inputs)
+    if args.command == "compact":
+        compact_history(args.history)
+        return 0
     regressions, lines = check_regressions(args.history, args.band)
     print("\n".join(lines))
     if regressions:

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Storage-lifecycle quick-gate: eviction is a recoverable miss, and a
+SIGKILLed GC leaves a tree that audits PASS and converges on re-run.
+
+The dynamic half of the ``vft-gc`` contract (gc.py, docs/storage.md),
+proven end-to-end on a tiny corpus:
+
+  1. **fill**: one extraction with ``cache=true`` populates a
+     content-addressed store;
+  2. **evict under quota**: ``vft-gc`` with a quota far below usage
+     LRU-evicts every cache entry — journaled to ``_gc_{host}.jsonl``
+     before each unlink;
+  3. **recoverable miss**: the SAME corpus re-extracts into a fresh
+     output dir and every artifact is byte-identical to pass 1 — an
+     eviction can change how long a run takes, never what it computes;
+  4. **crash-safe deletion**: a second fill, then ``vft-gc`` run as a
+     subprocess with ``VFT_INJECT=...gc.evict=kill@n2`` — SIGKILLed
+     between the second journal append and its unlink. ``vft-audit``
+     must PASS on the remains (journaled-but-present is a *note*), and
+     an un-faulted re-run must converge to an empty store.
+
+Exit 0 = contract holds; exit 1 = every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml); the in-suite twins are
+tests/test_gc.py and tests/test_chaos.py::test_gc_chaos_matrix, and
+``python bench.py bench_gc_overhead`` prices the accounting half.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+N_VIDEOS = 2
+
+
+def _extract(td: Path, out: str, vids: List[str]) -> None:
+    from video_features_tpu.cli import main as cli_main
+    with contextlib.redirect_stdout(io.StringIO()):
+        cli_main(["feature_type=resnet", "model_name=resnet18",
+                  "device=cpu", "allow_random_weights=true",
+                  "on_extraction=save_numpy", "extraction_total=6",
+                  "batch_size=8", "video_workers=1",
+                  "cache=true", f"cache_dir={td / 'store'}",
+                  f"tmp_path={td / 'tmp'}",
+                  "video_paths=[" + ",".join(vids) + "]",
+                  f"output_path={td / out}"])
+
+
+def check_gc(td: Path) -> List[str]:
+    from video_features_tpu import gc as vgc
+    from video_features_tpu.audit import audit_run
+    errs: List[str] = []
+    store = td / "store"
+    vids = []
+    for i in range(N_VIDEOS):
+        dst = td / f"smoke{i}.mp4"
+        shutil.copy(SAMPLE, dst)
+        vids.append(str(dst))
+
+    # 1+2: fill, then evict EVERYTHING under an impossible quota
+    _extract(td, "p1", vids)
+    n_entries = len(list(store.rglob("*.pkl")))
+    if not n_entries:
+        return [f"fill pass stored no cache entries under {store}"]
+    root = td / "gcroot"
+    root.mkdir()
+    rc = vgc.main([str(root), "--cache-dir", str(store),
+                   "--compile-dir", str(td / "cc"),
+                   "--quota-gb", "0.000001"])
+    if rc != 0:
+        errs.append(f"vft-gc one-shot exited {rc}")
+    left = list(store.rglob("*.pkl"))
+    if left:
+        errs.append(f"quota eviction left {len(left)} of {n_entries} "
+                    "cache entries behind")
+    if not list(root.glob("_gc_*.jsonl")):
+        errs.append("eviction ran but wrote no _gc_*.jsonl journal — "
+                    "the journal-before-unlink contract is broken")
+
+    # 3: the recoverable-miss proof — re-extract bit-identically
+    _extract(td, "p2", vids)
+    p1 = sorted(p.relative_to(td / "p1")
+                for p in (td / "p1").rglob("*.npy"))
+    p2 = sorted(p.relative_to(td / "p2")
+                for p in (td / "p2").rglob("*.npy"))
+    if p1 != p2 or len(p1) < N_VIDEOS:
+        errs.append(f"artifact sets diverged after eviction: "
+                    f"pass1={len(p1)} pass2={len(p2)} files")
+    for rel in p1:
+        if rel in p2 and (td / "p1" / rel).read_bytes() != \
+                (td / "p2" / rel).read_bytes():
+            errs.append(f"{rel}: post-eviction bytes differ — eviction "
+                        "must be a recoverable miss, not a change")
+
+    # 4: SIGKILL the GC between a journal append and its unlink. The
+    # dedup'd corpus refills exactly one real entry; two cold synthetic
+    # entries (the planner stats, it never unpickles) guarantee the
+    # sweep has a 2nd eviction for kill@n2 to land on
+    import time as _time
+    old = _time.time() - 3600
+    for i in range(2):
+        fake = store / "ff" / f"ff{i:02d}dead.pkl"
+        fake.parent.mkdir(parents=True, exist_ok=True)
+        fake.write_bytes(b"x" * 2048)
+        os.utime(fake, (old, old))
+    n_entries = len(list(store.rglob("*.pkl")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               VFT_INJECT="seed=7;gc.evict=kill@n2")
+    proc = subprocess.run(
+        [sys.executable, "-m", "video_features_tpu.gc", str(root),
+         "--cache-dir", str(store), "--compile-dir", str(td / "cc"),
+         "--quota-gb", "0.000001"],
+        env=env, cwd=str(REPO_ROOT), capture_output=True, text=True,
+        timeout=120)
+    if proc.returncode != -signal.SIGKILL:
+        errs.append("injected gc.evict=kill@n2 did not SIGKILL the "
+                    f"sweep (exit {proc.returncode}):\n{proc.stderr}")
+    survivors = list(store.rglob("*.pkl"))
+    if len(survivors) != n_entries - 1:
+        errs.append(f"expected exactly 1 completed eviction before the "
+                    f"kill, found {n_entries - len(survivors)}")
+    ok, violations, notes = audit_run(str(root))
+    if not ok:
+        errs.append("vft-audit FAILs the SIGKILLed GC's remains:\n  "
+                    + "\n  ".join(violations))
+    if not any("gc-journaled" in n for n in notes):
+        errs.append("audit found no journaled-but-present note — the "
+                    f"kill left no recoverable remnant? notes={notes!r}")
+
+    # ... and the next un-faulted run converges
+    rc = vgc.main([str(root), "--cache-dir", str(store),
+                   "--compile-dir", str(td / "cc"),
+                   "--quota-gb", "0.000001"])
+    if rc != 0:
+        errs.append(f"post-kill vft-gc exited {rc}")
+    if list(store.rglob("*.pkl")):
+        errs.append("post-kill re-run did not converge to an empty store")
+    ok, violations, _ = audit_run(str(root))
+    if not ok:
+        errs.append("vft-audit FAILs after convergence:\n  "
+                    + "\n  ".join(violations))
+    return errs
+
+
+def main() -> int:
+    if not SAMPLE.exists():
+        print(f"SKIP: vendored sample missing ({SAMPLE})")
+        return 0
+    with tempfile.TemporaryDirectory(prefix="vft_gc_smoke_") as td:
+        errs = check_gc(Path(td))
+    if errs:
+        print("GC SMOKE: FAIL")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"GC SMOKE: OK ({N_VIDEOS} videos: fill -> quota-evict -> "
+          "bit-identical re-extract; SIGKILL mid-sweep -> audit PASS -> "
+          "converged)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

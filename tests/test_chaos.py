@@ -641,3 +641,85 @@ def test_chaos_inject_worker_kill_replay(sample_video, tmp_path):
     got_npy = {p.name: p.read_bytes() for p in out.rglob("*.npy")}
     assert ref_npy == got_npy, \
         "killed-and-reclaimed run diverged from the clean run"
+
+
+# ---------------------------------------------------------------------------
+# GC chaos (gc.py, this PR's arc): the storage lifecycle plane under the
+# same seeded-plan discipline as the gateway matrix. Both seeds build a
+# synthetic over-retention tree, arm a plan, sweep, and prove the
+# journal-before-unlink contract: a dropped unlink (seed 40 — dying in
+# the crash window) or an injected EIO mid-sweep (seed 41, after a
+# stall) leaves journaled-but-present remnants that AUDIT as notes, and
+# a second, un-faulted sweep converges to the same end state.
+# ---------------------------------------------------------------------------
+
+GC_CHAOS_PLANS = {
+    40: "seed=40;gc.evict=drop@n1",
+    41: "seed=41;gc.sweep=stall@n1;gc.evict=eio@n1",
+}
+
+
+def _gc_litter(tmp_path):
+    """An over-retention tree: 3 cold cache entries + 3 expired spool
+    responses, every mtime 1000s in the past."""
+    root = tmp_path / "out"
+    cache = tmp_path / "cache"
+    old = time.time() - 1000.0
+    for i in range(3):
+        p = cache / f"{i:02x}" / f"{i:02x}beef.pkl"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(b"x" * 64)
+        os.utime(p, (old, old))
+    (root / "done").mkdir(parents=True)
+    for i in range(3):
+        p = root / "done" / f"rid{i}.json"
+        p.write_text(json.dumps({"id": f"rid{i}", "status": "done"}))
+        os.utime(p, (old, old))
+    return root, cache
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("seed", sorted(GC_CHAOS_PLANS))
+def test_gc_chaos_matrix(tmp_path, seed):
+    from video_features_tpu import gc as vgc
+    from video_features_tpu.audit import audit_run
+    from video_features_tpu.utils import inject
+
+    root, cache = _gc_litter(tmp_path)
+    cfg = vgc.GcConfig.from_args({"gc_cache_retention_s": 100,
+                                  "gc_spool_retention_s": 100})
+    kw = dict(cache_dir=str(cache), compile_dir=str(tmp_path / "cc"))
+    plan = inject.arm_for_run(GC_CHAOS_PLANS[seed])
+    try:
+        result = vgc.sweep(str(root), cfg, **kw)
+    finally:
+        inject.disarm()
+
+    assert result["planned"] == 6
+    assert plan.fired.get("gc.evict") == 1
+    if seed == 41:
+        assert plan.fired.get("gc.sweep") == 1
+        # the injected EIO is a counted error, never a crashed sweep
+        assert result["executed"]["cache"]["errors"] == 1
+    # exactly one deletion was journaled but never happened; every
+    # other one completed despite the armed plan
+    journal = list(root.glob("_gc_*.jsonl"))
+    assert len(journal) == 1
+    remnants = [p for p in (*cache.rglob("*.pkl"),
+                            *(root / "done").glob("*.json"))]
+    assert len(remnants) == 1, remnants
+
+    # the invariant audit sees the remnant as RECOVERABLE, not a FAIL
+    ok, violations, notes = audit_run(str(root))
+    assert ok, "\n".join(violations)
+    assert any("gc-journaled" in n for n in notes), notes
+
+    # a second, un-faulted sweep converges: the remnant still satisfies
+    # its planner, gets re-journaled, and this time the unlink lands
+    result2 = vgc.sweep(str(root), cfg, **kw)
+    assert result2["planned"] == 1
+    assert not list(cache.rglob("*.pkl"))
+    assert not list((root / "done").glob("*.json"))
+    ok, violations, notes = audit_run(str(root))
+    assert ok, "\n".join(violations)
+    assert not any("gc-journaled" in n for n in notes), notes

@@ -240,6 +240,25 @@ def test_staging_orphan_recovered(tmp_path):
     assert rec["id"] == "it-1234" and rec["reclaims"] == 1
 
 
+def test_staging_retention_config_replaces_lease_heuristic(tmp_path):
+    """gc_staging_retention_s governs orphan recovery when set: a long
+    retention holds an entry the old 4-lease heuristic would already
+    have swept; once the (fake) clock passes it, the sweep recovers."""
+    clk = Clock()
+    a = _wq(tmp_path, "A", clk, lease_s=5.0, staging_retention_s=100.0)
+    staging = tmp_path / "_queue" / ".staging" / "dead.it-7.json"
+    staging.write_text(json.dumps(
+        {"schema": fq.ITEM_SCHEMA, "id": "it-7",
+         "video": "/data/v.mp4", "reclaims": 0}))
+    os.utime(staging, (clk.t - 30.0, clk.t - 30.0))  # > 4 leases (20s)
+    assert a.reclaim_expired() == 0  # held: configured retention wins
+    clk.t += 80.0                    # age 110s > retention 100s
+    assert a.reclaim_expired() == 1
+    assert a.claim_next()["id"] == "it-7"
+    with pytest.raises(ValueError):
+        _wq(tmp_path, "B", clk, staging_retention_s=0.0)
+
+
 def test_drain_exactly_once_across_hosts(tmp_path):
     # real wall clock here: drain idle-waits on a real threading.Event
     videos = [f"/data/v{i:02d}.mp4" for i in range(12)]

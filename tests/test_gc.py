@@ -1,0 +1,511 @@
+"""Storage lifecycle plane (gc.py): config validation, per-plane and
+per-tenant accounting, the planner safety rules, journal-before-unlink
+execution + audit reconciliation, monitor caching — plus the PR's
+satellites: telemetry ENOSPC degradation latches, stale weights
+``.part`` sweeping, and bench-history compaction."""
+import errno
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu import gc as vgc
+from video_features_tpu import telemetry
+from video_features_tpu.audit import audit_run
+from video_features_tpu.config import load_config, sanity_check
+from video_features_tpu.telemetry import jsonl as tjsonl
+from video_features_tpu.telemetry.jsonl import append_jsonl
+
+pytestmark = pytest.mark.quick
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+NOW = 1_000_000.0
+
+
+class Clock:
+    def __init__(self, t: float = NOW) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _touch(path: Path, nbytes: int = 16, *, age_s: float = 0.0,
+           text: str = None) -> Path:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if text is not None:
+        path.write_text(text)
+    else:
+        path.write_bytes(b"x" * nbytes)
+    if age_s:
+        t = time.time() - age_s
+        os.utime(path, (t, t))
+    return path
+
+
+def _cfg(**kw) -> vgc.GcConfig:
+    return vgc.GcConfig(**kw)
+
+
+# -- config surface ---------------------------------------------------------
+
+def test_validate_gc_args_accepts_the_full_surface():
+    vgc.validate_gc_args({"gc": True, "gc_quota_gb": 50,
+                          "gc_cache_retention_s": 3600,
+                          "gc_spool_retention_s": "86400",
+                          "gc_interval_s": 60})
+    vgc.validate_gc_args({})  # nothing gc-related: nothing to check
+
+
+@pytest.mark.parametrize("bad", [
+    {"gc": "yes"},
+    {"gc_quota_gb": 0},
+    {"gc_quota_gb": -1},
+    {"gc_quota_gb": "plenty"},
+    {"gc_cache_retention_s": 0},
+    {"gc_inbox_retention_s": "forever"},
+    {"gc_interval_s": -5},
+])
+def test_validate_gc_args_rejects(bad):
+    with pytest.raises(ValueError):
+        vgc.validate_gc_args(bad)
+
+
+def test_sanity_check_delegates_gc_validation(tmp_path):
+    """A typo'd gc knob on a run config fails at launch, exactly like
+    any other key — the CLI and vft-gc validate identically."""
+    cfg = load_config("resnet", {
+        "video_paths": "x.mp4", "device": "cpu",
+        "output_path": str(tmp_path / "out"),
+        "tmp_path": str(tmp_path / "tmp"),
+        "gc": True, "gc_quota_gb": -3,
+    })
+    with pytest.raises(ValueError, match="gc_quota_gb"):
+        sanity_check(cfg)
+
+
+def test_config_from_args_resolves_quota_to_bytes():
+    cfg = vgc.GcConfig.from_args({"gc_quota_gb": 0.5,
+                                  "gc_cache_retention_s": 10})
+    assert cfg.quota_bytes == int(0.5e9)
+    assert cfg.cache_retention_s == 10.0
+    assert cfg.spool_retention_s is None  # unset = account-only
+    assert cfg.interval_s == 300.0
+
+
+# -- usage accounting -------------------------------------------------------
+
+def test_usage_accounts_planes_and_tenants(tmp_path):
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    _touch(root / "done" / "r1.json", 100)
+    _touch(root / "expired" / "r2.json", 50)
+    _touch(root / "inbox" / "blobA", 300)
+    _touch(root / "_incidents" / "b1" / "hb.json", 40)
+    _touch(root / "_queue" / "quarantined" / "q.json", 20)
+    _touch(root / "_queue" / ".staging" / "s.json", 10)
+    _touch(root / "_telemetry.jsonl", 64)
+    _touch(cache / "ab" / "abcd.pkl", 500)
+    # tenant attribution comes from the gateway admission journal, not
+    # from unpickling cache entries (the tenant salt is irreversible)
+    gw = root / "_gateway_h1.jsonl"
+    append_jsonl(gw, {"event": "upload", "tenant": "acme",
+                      "sha256": "aa", "bytes": 300})
+    append_jsonl(gw, {"event": "upload", "tenant": "acme",
+                      "sha256": "aa", "bytes": 300, "dedup": True})
+    append_jsonl(gw, {"event": "accepted", "tenant": "acme", "id": "r1"})
+
+    use = vgc.usage(str(root), cache_dir=str(cache), compile_dir=str(comp))
+    p = use["planes"]
+    assert p["cache"] == {"files": 1, "bytes": 500}
+    assert p["spool"] == {"files": 2, "bytes": 150}
+    assert p["inbox"]["bytes"] == 300
+    assert p["incidents"]["bytes"] == 40
+    assert p["quarantine"]["bytes"] == 20
+    assert p["staging"]["bytes"] == 10
+    assert p["compile"] == {"files": 0, "bytes": 0}
+    # journals: _telemetry.jsonl + the gateway journal itself
+    assert p["journals"]["files"] == 2
+    t = use["tenants"]["acme"]
+    assert t["upload_bytes"] == 300  # the dedup'd re-upload is excluded
+    assert t["accepted"] == 1
+    assert t["spool_bytes"] == 100  # done/r1.json priced via rid->tenant
+    assert use["total_bytes"] == sum(v["bytes"] for v in p.values())
+
+
+# -- planner safety rules ---------------------------------------------------
+
+def test_plan_cache_lru_coldest_first_under_quota(tmp_path):
+    cache = tmp_path / "cache"
+    for i, age in enumerate((5000.0, 3000.0, 10.0)):
+        _touch(cache / f"{i:02x}" / f"{i:02x}beef.pkl", 100, age_s=age)
+    cfg = _cfg()
+    # need 150 bytes back: the two coldest go, the hot entry survives
+    dels = vgc.plan_cache(str(cache), cfg, time.time(), 150)
+    assert [os.path.basename(d.path) for d in dels] == \
+        ["00beef.pkl", "01beef.pkl"]
+    assert all(d.plane == "cache" for d in dels)
+    # no quota pressure, no retention: nothing planned
+    assert vgc.plan_cache(str(cache), cfg, time.time(), 0) == []
+
+
+def test_plan_cache_retention_expiry(tmp_path):
+    cache = tmp_path / "cache"
+    _touch(cache / "aa" / "aaold.pkl", 10, age_s=5000.0)
+    _touch(cache / "bb" / "bbnew.pkl", 10, age_s=10.0)
+    dels = vgc.plan_cache(str(cache), _cfg(cache_retention_s=1000.0),
+                          time.time(), 0)
+    assert [os.path.basename(d.path) for d in dels] == ["aaold.pkl"]
+    assert "retention" in dels[0].reason
+
+
+def test_plan_spool_never_deletes_a_claimable_response(tmp_path):
+    root = tmp_path
+    _touch(root / "done" / "r1.json", 10, age_s=5000.0)     # claimable!
+    _touch(root / "done" / "r2.json", 10, age_s=5000.0)     # expirable
+    _touch(root / "expired" / "r3.json", 10, age_s=10.0)    # too young
+    _touch(root / "requests" / "r1.json", text=json.dumps({"id": "r1"}))
+    dels = vgc.plan_spool(str(root), _cfg(spool_retention_s=1000.0),
+                          time.time())
+    assert [os.path.basename(d.path) for d in dels] == ["r2.json"]
+    # a claimed/ file pins the rid exactly like requests/
+    _touch(root / "claimed" / "hostX" / "r2.json",
+           text=json.dumps({"id": "r2"}))
+    assert vgc.plan_spool(str(root), _cfg(spool_retention_s=1000.0),
+                          time.time()) == []
+
+
+def test_plan_inbox_never_deletes_a_referenced_blob(tmp_path):
+    root = tmp_path
+    _touch(root / "inbox" / "blobA", 10, age_s=5000.0)  # referenced
+    _touch(root / "inbox" / "blobB", 10, age_s=5000.0)  # orphaned
+    _touch(root / "requests" / "r1.json", text=json.dumps(
+        {"id": "r1", "video_paths": [str(root / "inbox" / "blobA")]}))
+    dels = vgc.plan_inbox(str(root), _cfg(inbox_retention_s=1000.0),
+                          time.time())
+    assert [os.path.basename(d.path) for d in dels] == ["blobB"]
+
+
+def test_plan_incidents_honors_pinned_marker(tmp_path):
+    root = tmp_path
+    _touch(root / "_incidents" / "keep" / "hb.json", 10)
+    _touch(root / "_incidents" / "keep" / "pinned", 0)
+    _touch(root / "_incidents" / "drop" / "hb.json", 10)
+    for b in ("keep", "drop"):
+        t = time.time() - 5000.0
+        os.utime(root / "_incidents" / b, (t, t))
+    dels = vgc.plan_incidents(str(root),
+                              _cfg(incident_retention_s=1000.0),
+                              time.time())
+    assert [os.path.basename(d.path) for d in dels] == ["drop"]
+    assert dels[0].is_dir
+
+
+def test_plan_compile_pins_matching_env_fp(tmp_path):
+    from video_features_tpu.compile_cache import env_fingerprint
+    _env, fp = env_fingerprint()
+    comp = tmp_path / "compile"
+
+    def entry(key, env_fp, age_s):
+        d = comp / "resnet" / key[:2] / key
+        _touch(d / "_entry.json", text=json.dumps({"env_fp": env_fp}))
+        _touch(d / "blob.bin", 100)
+        t = time.time() - age_s
+        os.utime(d, (t, t))
+
+    entry("aa11", fp, 9000.0)        # this host's fingerprint: pinned
+    entry("bb22", "ffff", 9000.0)    # foreign + idle: pruned
+    entry("cc33", "ffff", 10.0)      # foreign but young: kept
+    dels = vgc.plan_compile(str(comp), _cfg(compile_retention_s=1000.0),
+                            time.time())
+    assert [os.path.basename(d.path) for d in dels] == ["bb22"]
+    assert dels[0].is_dir and dels[0].bytes > 0
+
+
+def test_plan_staging_requires_done_marker(tmp_path):
+    root = tmp_path
+    _touch(root / "_queue" / ".staging" / "a.json",
+           text=json.dumps({"id": "it-1"}))
+    _touch(root / "_queue" / ".staging" / "b.json",
+           text=json.dumps({"id": "it-2"}))
+    for fn in ("a.json", "b.json"):
+        p = root / "_queue" / ".staging" / fn
+        t = time.time() - 5000.0
+        os.utime(p, (t, t))
+    _touch(root / "_queue" / "done" / "it-1.json",
+           text=json.dumps({"id": "it-1", "status": "done"}))
+    dels = vgc.plan_staging(str(root), _cfg(staging_retention_s=1000.0),
+                            time.time())
+    # it-2 has no done marker: unfinished work belongs to the queue's
+    # own sweep, never to GC — only the completed remnant is planned
+    assert [os.path.basename(d.path) for d in dels] == ["a.json"]
+
+
+def test_plan_quarantine_expires_by_age(tmp_path):
+    root = tmp_path
+    _touch(root / "_queue" / "quarantined" / "old.json", 10, age_s=5000.0)
+    _touch(root / "_queue" / "quarantined" / "new.json", 10, age_s=10.0)
+    dels = vgc.plan_quarantine(str(root),
+                               _cfg(quarantine_retention_s=1000.0),
+                               time.time())
+    assert [os.path.basename(d.path) for d in dels] == ["old.json"]
+
+
+def test_plan_quota_pressure_only_touches_cache(tmp_path):
+    """Quota overflow is resolved against the recoverable plane only —
+    spool/inbox/incident responses are never sacrificed to a byte
+    target."""
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    _touch(cache / "aa" / "aadead.pkl", 4000, age_s=100.0)
+    _touch(root / "done" / "r1.json", 4000, age_s=100.0)
+    _touch(root / "inbox" / "blob", 4000, age_s=100.0)
+    cfg = vgc.GcConfig(quota_gb=1e-6)  # 1000 bytes: far over quota
+    dels = vgc.plan(str(root), cfg, cache_dir=str(cache),
+                    compile_dir=str(comp))
+    assert {d.plane for d in dels} == {"cache"}
+
+
+# -- journaled execution ----------------------------------------------------
+
+def test_execute_journals_before_unlink(tmp_path):
+    root = tmp_path
+    victim = _touch(root / "done" / "r9.json", 64, age_s=5000.0)
+    dels = vgc.plan_spool(str(root), _cfg(spool_retention_s=1000.0),
+                          time.time())
+    tally = vgc.execute(str(root), dels, host_id="testhost")
+    assert not victim.exists()
+    assert tally == {"spool": {"deleted": 1, "bytes": 64, "errors": 0}}
+    jpath = root / vgc.journal_filename("testhost")
+    recs = list(tjsonl.read_jsonl(jpath))
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["schema"] == vgc.GC_JOURNAL_SCHEMA
+    assert r["event"] == "evict" and r["plane"] == "spool"
+    assert r["path"] == str(victim) and r["bytes"] == 64
+    # re-executing the same plan converges silently (FileNotFoundError
+    # = a sibling GC or the owner got there first)
+    tally2 = vgc.execute(str(root), dels, host_id="testhost")
+    assert tally2["spool"]["errors"] == 0
+
+
+def test_journal_remnant_is_a_recoverable_audit_note(tmp_path):
+    """A journaled-but-present path = the GC died in the crash window.
+    vft-audit notes it; completing the delete clears the note."""
+    root = tmp_path
+    victim = _touch(root / "done" / "r1.json", 32, age_s=5000.0)
+    d = vgc.Deletion("spool", str(victim), 32, "test remnant")
+    append_jsonl(str(root / vgc.journal_filename("h1")),
+                 vgc._journal_record(d, str(root), "h1"))
+    ok, violations, notes = audit_run(str(root))
+    assert ok and not violations
+    assert any("gc-journaled" in n for n in notes)
+    victim.unlink()
+    ok, violations, notes = audit_run(str(root))
+    assert ok and not any("gc-journaled" in n for n in notes)
+
+
+def test_audit_fails_deleted_but_still_referenced(tmp_path):
+    """The states the safety rules promise cannot happen: a deleted
+    spool response whose request is claimable again, a deleted inbox
+    blob a live request references."""
+    root = tmp_path
+    _touch(root / "requests" / "r1.json", text=json.dumps(
+        {"id": "r1", "video_paths": [str(root / "inbox" / "blobZ")]}))
+    jp = str(root / vgc.journal_filename("h1"))
+    append_jsonl(jp, vgc._journal_record(
+        vgc.Deletion("spool", str(root / "done" / "r1.json"), 1, "bad"),
+        str(root), "h1"))
+    append_jsonl(jp, vgc._journal_record(
+        vgc.Deletion("inbox", str(root / "inbox" / "blobZ"), 1, "bad"),
+        str(root), "h1"))
+    ok, violations, _notes = audit_run(str(root))
+    assert not ok
+    assert any("claimable" in v for v in violations)
+    assert any("still referenced" in v for v in violations)
+
+
+def test_sweep_dry_run_deletes_nothing(tmp_path):
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    victim = _touch(cache / "aa" / "aa.pkl", 100, age_s=5000.0)
+    res = vgc.sweep(str(root), _cfg(cache_retention_s=1000.0),
+                    cache_dir=str(cache), compile_dir=str(comp),
+                    dry_run=True)
+    assert res["planned"] == 1 and res["planned_bytes"] == 100
+    assert res["executed"] == {} and res["dry_run"]
+    assert victim.exists()
+    assert not list(Path(root).glob(vgc.GC_JOURNAL_GLOB))
+    lines = "\n".join(vgc.render_report(res))
+    assert "dry run" in lines and "== usage ==" in lines
+
+
+# -- monitor + heartbeat section --------------------------------------------
+
+def test_monitor_caches_walks_on_interval(tmp_path):
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    _touch(cache / "aa" / "aa.pkl", 100)
+    clk = Clock()
+    mon = vgc.GcMonitor(str(root), vgc.GcConfig(quota_gb=1.0,
+                                                interval_s=60.0),
+                        cache_dir=str(cache), compile_dir=str(comp),
+                        clock=clk)
+    sec = mon.section()
+    assert sec["used_bytes"] == 100
+    assert sec["quota_bytes"] == int(1e9)
+    assert sec["planes"]["cache"] == 100
+    # inside the interval the cached snapshot is republished — the
+    # heartbeat cadence never pays a tree walk
+    _touch(cache / "bb" / "bb.pkl", 50)
+    assert mon.section()["used_bytes"] == 100
+    clk.t += 61.0
+    assert mon.section()["used_bytes"] == 150
+
+
+def test_monitor_attach_publishes_gauges(tmp_path):
+    from video_features_tpu.telemetry.recorder import TelemetryRecorder
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    root.mkdir()
+    _touch(cache / "aa" / "aa.pkl", 100)
+    rec = TelemetryRecorder(str(root))
+    mon = vgc.GcMonitor(str(root), vgc.GcConfig(quota_gb=1.0),
+                        cache_dir=str(cache), compile_dir=str(comp)
+                        ).attach(rec)
+    assert rec.extra_sections["gc"] == mon.section
+    mon.snapshot()
+    assert rec.registry.gauge("vft_gc_used_bytes").value == 100
+    assert rec.registry.gauge("vft_gc_quota_bytes").value == int(1e9)
+    assert rec.registry.gauge("vft_gc_plane_bytes",
+                              plane="cache").value == 100
+
+
+def test_cli_one_shot_json(tmp_path, capsys):
+    root = tmp_path / "root"
+    cache = tmp_path / "cache"
+    comp = tmp_path / "compile"
+    root.mkdir()
+    _touch(cache / "aa" / "aa.pkl", 64, age_s=5000.0)
+    rc = vgc.main([str(root), "--cache-dir", str(cache),
+                   "--compile-dir", str(comp),
+                   "--cache-retention-s", "1000", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["planned"] == 1
+    assert out["executed"]["cache"]["deleted"] == 1
+    assert not (cache / "aa" / "aa.pkl").exists()
+
+
+def test_cli_rejects_bad_flags(tmp_path):
+    with pytest.raises(ValueError, match="gc_quota_gb"):
+        vgc.main([str(tmp_path), "--quota-gb", "-1"])
+
+
+# -- satellite: telemetry writers degrade on ENOSPC -------------------------
+
+def _enospc(*_a, **_k):
+    raise OSError(errno.ENOSPC, "No space left on device")
+
+
+def test_emit_span_enospc_disables_pillar_once(tmp_path, monkeypatch,
+                                               capsys):
+    from video_features_tpu.telemetry.recorder import TelemetryRecorder
+    rec = TelemetryRecorder(str(tmp_path))
+    monkeypatch.setattr(tjsonl, "append_jsonl", _enospc)
+    rec.emit_span({"status": "done", "wall_s": 1.0})
+    rec.emit_span({"status": "done", "wall_s": 1.0})
+    assert rec._spans_disabled
+    assert rec.registry.counter("vft_telemetry_write_failures_total",
+                                pillar="spans").value == 1
+    out = capsys.readouterr().out
+    assert out.count("span channel disabled") == 1
+    # the in-memory pillars keep flowing after the latch
+    assert rec.registry.counter("vft_videos_total",
+                                status="done").value == 2
+
+
+def test_history_writer_enospc_disables(tmp_path, monkeypatch, capsys):
+    from video_features_tpu.telemetry.history import HistoryWriter
+    from video_features_tpu.telemetry.recorder import TelemetryRecorder
+    rec = TelemetryRecorder(str(tmp_path))
+    telemetry._set_active(rec)
+    try:
+        hw = HistoryWriter(str(tmp_path), "h1")
+        monkeypatch.setattr(tjsonl, "append_jsonl", _enospc)
+        hw.observe({"time": 1.0})
+        hw.observe({"time": 2.0})
+        assert hw._disabled
+        assert rec.registry.counter(
+            "vft_telemetry_write_failures_total",
+            pillar="history").value == 1
+        assert capsys.readouterr().out.count(
+            "history retention disabled") == 1
+    finally:
+        telemetry._set_active(None)
+
+
+def test_trace_close_enospc_never_raises(tmp_path, monkeypatch, capsys):
+    from video_features_tpu.telemetry.trace import TraceRecorder
+    tr = TraceRecorder(str(tmp_path), host_id="h1")
+    monkeypatch.setattr(tjsonl, "write_json_atomic", _enospc)
+    assert tr.close() is None  # degraded, not raised into the finally
+    assert "failed to write" in capsys.readouterr().out
+    assert not list(Path(tmp_path).glob("_trace*"))
+
+
+# -- satellite: weights .part litter sweep ----------------------------------
+
+def test_sweep_stale_parts(tmp_path):
+    from video_features_tpu.weights.store import sweep_stale_parts
+    stale = _touch(tmp_path / "resnet50.npz.abc123.part", 10,
+                   age_s=7200.0)
+    fresh = _touch(tmp_path / "clip.npz.def456.part", 10, age_s=60.0)
+    done = _touch(tmp_path / "resnet50.npz", 10, age_s=7200.0)
+    assert sweep_stale_parts(tmp_path) == 1
+    assert not stale.exists()
+    assert fresh.exists()  # a concurrent fetcher may still be streaming
+    assert done.exists()   # promoted checkpoints are never litter
+    assert sweep_stale_parts(tmp_path) == 0  # idempotent
+    assert sweep_stale_parts(tmp_path / "missing") == 0
+
+
+# -- satellite: bench-history compaction ------------------------------------
+
+def test_bench_history_compaction_tiers(tmp_path):
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    import bench_history as bh
+    path = str(tmp_path / "BENCH_history.jsonl")
+    now = 2_000_000_000.0
+    day = 86400.0
+    # 10 recent daily rounds + 30 old 6-hourly rounds (the mid tier
+    # keeps one per day) + 2 ancient rounds past the final tier
+    ages = [i * day for i in range(10)] \
+        + [40 * day + i * day / 4 for i in range(30)] \
+        + [800 * day, 900 * day]
+    for i, age in enumerate(ages):
+        append_jsonl(path, {"schema": bh.SCHEMA_VERSION, "round": i,
+                            "source": f"r{i}", "recorded_time": now - age,
+                            "headline": {"metric": "m", "value": 1.0},
+                            "metrics": []})
+    kept = bh.compact_history(path, now=now)
+    rows = bh.load_history(path)
+    assert kept == len(rows)
+    times = [r["recorded_time"] for r in rows]
+    # recent tier: everything survives; ancient: dropped entirely
+    assert sum(1 for t in times if now - t < 30 * day) == 10
+    assert all(now - t <= 730 * day for t in times)
+    # mid tier: 30 quarter-day rounds collapse to ~one per day
+    mid = [t for t in times if 30 * day <= now - t <= 180 * day]
+    assert 7 <= len(mid) <= 9
+    # the records keep the bench schema (no leaked "time" shim key)
+    assert all("time" not in r for r in rows)
+    assert bh.compact_history(path, now=now) == kept  # idempotent

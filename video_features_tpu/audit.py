@@ -49,6 +49,13 @@ durability machinery promises to hold under ANY interleaving of crashes
      the gateway rejected/shed at the door never reached the spool; and
      per-tenant accepted counts in the gateway journals reconcile with
      the spool's terminal (done/expired) markers (gateway.py).
+ 11. **GC deletions reconcile with their journal** — every ``vft-gc``
+     deletion is journaled to ``_gc_{host}.jsonl`` BEFORE the unlink
+     (gc.py): a journaled path still present is a *note* (the GC died
+     in the crash window; the next run converges), but a journaled
+     spool/inbox deletion whose request is claimable again or whose
+     blob a live request references is a violation — the safety rules
+     promise GC never deletes what the fleet can still reach.
 
 Violations are states the machinery PROMISES cannot happen no matter
 where a worker died; notes are recoverable in-flight states a killed
@@ -571,6 +578,51 @@ class Audit:
             n_checked += 1
         self.stats["cache_entries_verified"] = n_checked
 
+    def check_gc(self) -> None:
+        """Invariant 11: every ``_gc_*.jsonl`` evict record either
+        completed (path gone and, for spool/inbox, still safe to be
+        gone) or is a recoverable journal-then-die remnant (note)."""
+        from .gc import GC_JOURNAL_GLOB, _claimable_rids, \
+            _referenced_inbox_blobs
+        from .telemetry.jsonl import read_jsonl
+        journals = sorted(self.root.glob(GC_JOURNAL_GLOB))
+        if not journals:
+            return
+        live_rids = _claimable_rids(str(self.root))
+        live_blobs = _referenced_inbox_blobs(str(self.root))
+        n_records = n_pending = 0
+        for jp in journals:
+            for rec in read_jsonl(jp):
+                if rec.get("event") != "evict":
+                    continue
+                n_records += 1
+                path = rec.get("path") or ""
+                plane = rec.get("plane")
+                base = os.path.basename(path)
+                if os.path.exists(path):
+                    n_pending += 1
+                    continue  # journaled-but-present: noted in bulk below
+                # deleted: the safety rule must still hold NOW
+                if plane == "spool" and base.endswith(".json") and \
+                        base[:-len(".json")] in live_rids:
+                    self.violation(
+                        f"gc journal {jp.name} deleted spool response "
+                        f"{base} whose request is claimable — the "
+                        "claimable-rid rule (gc.py plan_spool) promises "
+                        "this never happens")
+                elif plane == "inbox" and base in live_blobs:
+                    self.violation(
+                        f"gc journal {jp.name} deleted inbox blob {base} "
+                        "still referenced by a live request — the "
+                        "reference rule (gc.py plan_inbox) promises this "
+                        "never happens")
+        if n_pending:
+            self.note(
+                f"{n_pending} gc-journaled deletion(s) not yet on disk "
+                "— the GC died between journal and unlink; the next "
+                "vft-gc run re-plans and completes them (recoverable)")
+        self.stats["gc_journal_records"] = n_records
+
     # -- driver --------------------------------------------------------------
     def run(self) -> bool:
         if not self.root.is_dir():
@@ -585,6 +637,7 @@ class Audit:
         self.check_health()
         self.check_artifact_spans()
         self.check_cache()
+        self.check_gc()
         return not self.violations
 
 

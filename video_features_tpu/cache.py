@@ -107,6 +107,14 @@ NON_SEMANTIC_KEYS = frozenset({
     # sink format changes the FILE, not the feature values; entries store
     # arrays and are written through whichever sink the run uses
     "on_extraction", "show_pred",
+    # storage lifecycle knobs (gc.py): eviction is always a recoverable
+    # miss — deleting an entry can change how long a run takes, never
+    # what any (video, config, weights) triple computes
+    "gc", "gc_quota_gb", "gc_cache_retention_s",
+    "gc_compile_retention_s", "gc_spool_retention_s",
+    "gc_inbox_retention_s", "gc_incident_retention_s",
+    "gc_quarantine_retention_s", "gc_staging_retention_s",
+    "gc_interval_s",
 })
 
 #: config keys that DO bear on feature values — they stay in the
@@ -384,6 +392,13 @@ class FeatureCache:
                 except OSError:
                     pass
                 return None
+            try:
+                # last-hit signal for the LRU eviction plane (gc.py):
+                # mtime bump on a VERIFIED hit only — no sidecar file, so
+                # gc=false runs stay byte-identical in artifacts
+                os.utime(path)
+            except OSError:
+                pass
             trace.instant("cache.hit", video=str(video_path),
                           family=self.family, key=key[:12])
             return feats

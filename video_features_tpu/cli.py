@@ -324,6 +324,15 @@ def main(argv: Optional[List[str]] = None) -> None:
             from .telemetry.alerts import AlertEngine
             alert_engine = AlertEngine(
                 out_root, run_id=recorder.run_id).attach(recorder)
+        # Storage lifecycle accounting (gc=true, gc.py): a heartbeat
+        # "gc" section with per-plane/per-tenant byte usage (cached —
+        # the tree walk refreshes at most every gc_interval_s) plus the
+        # vft_gc_* gauges the disk_pressure alert rule projects from.
+        # Accounting only: eviction is vft-gc's job (docs/storage.md).
+        # gc=false (default) registers nothing — zero footprint.
+        if bool(args.get("gc", False)):
+            from .gc import GcConfig, GcMonitor
+            GcMonitor(out_root, GcConfig.from_args(args)).attach(recorder)
         recorder.start()
 
     # Pipeline tracing (trace=true): a Chrome-trace timeline of the host
@@ -376,7 +385,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             out_root, host_id=host_id, run_id=recorder.run_id,
             lease_s=float(args.get("fleet_lease_s") or 60.0),
             max_reclaims=int(args.get("fleet_max_reclaims") or 3),
-            journal=(journal if not multi_mode else None))
+            journal=(journal if not multi_mode else None),
+            staging_retention_s=(
+                float(args["gc_staging_retention_s"])
+                if args.get("gc_staging_retention_s") is not None
+                else None))
         recorder.extra_sections["fleet"] = work_queue.heartbeat_section
         # canary warm fast path (compile_cache.py): a joining host whose
         # compile-cache fingerprint fully hit has no cold-compile jitter

@@ -60,6 +60,12 @@ LAUNCH_KEYS = frozenset({
     "gateway_max_queued", "gateway_spool_bound", "gateway_max_body_mb",
     "gateway_poll_interval_s", "gateway_expire_grace_s",
     "gateway_default_timeout_s",
+    # vft-gc launch keys (gc.py validate_gc_args)
+    "gc", "gc_quota_gb", "gc_cache_retention_s",
+    "gc_compile_retention_s", "gc_spool_retention_s",
+    "gc_inbox_retention_s", "gc_incident_retention_s",
+    "gc_quarantine_retention_s", "gc_staging_retention_s",
+    "gc_interval_s",
 })
 
 #: removed reference flags: accepted, warned about and deleted by
@@ -424,6 +430,13 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
     if any(str(k).startswith("gateway_") for k in args):
         from .gateway import validate_gateway_args
         validate_gateway_args(args)
+
+    # storage lifecycle keys (gc.py): quotas/retentions — full validation
+    # lives with the GC plane so vft-gc and any run carrying gc keys
+    # fail a typo identically
+    if "gc" in args or any(str(k).startswith("gc_") for k in args):
+        from .gc import validate_gc_args
+        validate_gc_args(args)
 
     # compile-cache keys (compile_cache.py): the fleet-shared persistent
     # XLA store — a typo'd switch must not silently compile cold forever

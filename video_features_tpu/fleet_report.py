@@ -295,6 +295,11 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
             str(t), {"requests": 0, "violations": 0, "rejects": 0})
     idle_inputs = {"idle_wait_s_total": 0.0, "uptime_s": 0.0,
                    "fleet_hosts": 0}
+    # storage accounting (gc.py GcMonitor): every host samples the SAME
+    # shared root, so the fleet view is the freshest host's snapshot,
+    # not a sum — summing would multiply the tree by n_hosts
+    gc_section: Optional[dict] = None
+    gc_time = float("-inf")
     for e in current:
         hb = e["hb"]
         cc = hb.get("compile_cache")
@@ -343,6 +348,15 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
                 tt = _tenant(t)
                 tt["rejects"] += (int(v.get("rejected") or 0)
                                   + int(v.get("shed") or 0))
+        g_sec = hb.get("gc")
+        if isinstance(g_sec, dict):
+            try:
+                t_hb = float(hb.get("time") or 0.0)
+            except (TypeError, ValueError):
+                t_hb = 0.0
+            if t_hb > gc_time:
+                gc_time = t_hb
+                gc_section = dict(g_sec)
     for tt in tenant_totals.values():
         n = int(tt["requests"])
         tt["attainment_pct"] = (
@@ -392,6 +406,9 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         # over the fleet totals, verdict re-derived; None when no host
         # ran with roofline=true
         "roofline": _roofline_rollup(root),
+        # storage accounting (gc.py): the freshest host's usage snapshot
+        # of the shared planes; None when no host ran with gc=true
+        "gc": gc_section,
     }
 
 
@@ -750,6 +767,20 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
             f"== roofline ==  peak={dev.get('peak_tflops')} TFLOPS "
             f"[{dev.get('source')}]  " + "; ".join(parts)
             + "  (vft-roofline for the full table)")
+    gc = agg.get("gc")
+    if isinstance(gc, dict):
+        used = float(gc.get("used_bytes") or 0)
+        quota = gc.get("quota_bytes")
+        line = f"== storage ==  used={used / 1e9:.2f}GB"
+        if quota:
+            line += (f"  quota={float(quota) / 1e9:.2f}GB "
+                     f"({100.0 * used / float(quota):.0f}%)")
+        planes = gc.get("planes") or {}
+        top = sorted(planes.items(), key=lambda kv: -float(kv[1] or 0))
+        if top:
+            line += "  " + " ".join(
+                f"{p}={float(b or 0) / 1e9:.2f}GB" for p, b in top[:4])
+        lines.append(line + "  (vft-gc for the full report)")
     if capacity is not None:
         lines += render_capacity(capacity)
     fams = agg["families"]
@@ -855,6 +886,15 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
               family=fam)
         g("vft_roofline_peak_tflops",
           (rf.get("device") or {}).get("peak_tflops"))
+    gc = agg.get("gc")
+    if isinstance(gc, dict):
+        g("vft_gc_used_bytes", gc.get("used_bytes"))
+        if gc.get("quota_bytes"):
+            g("vft_gc_quota_bytes", gc["quota_bytes"])
+        for plane, b in sorted((gc.get("planes") or {}).items()):
+            g("vft_gc_plane_bytes", b, plane=plane)
+        for tenant, b in sorted((gc.get("tenants") or {}).items()):
+            g("vft_gc_tenant_bytes", b, tenant=tenant)
     for fam, f in agg["families"].items():
         g("vft_fleet_family_done", f["done"], family=fam)
         g("vft_fleet_family_errors", f["error"], family=fam)

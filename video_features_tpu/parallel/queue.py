@@ -133,17 +133,27 @@ class WorkQueue:
                  run_id: Optional[str] = None,
                  lease_s: float = 60.0, max_reclaims: int = 3,
                  journal=None,
+                 staging_retention_s: Optional[float] = None,
                  clock: Callable[[], float] = time.time) -> None:
         if float(lease_s) <= 0:
             raise ValueError(f"fleet_lease_s={lease_s}: need > 0")
         if int(max_reclaims) < 1:
             raise ValueError(f"fleet_max_reclaims={max_reclaims}: need >= 1")
+        if staging_retention_s is not None and float(staging_retention_s) <= 0:
+            raise ValueError(
+                f"gc_staging_retention_s={staging_retention_s}: need > 0")
         self.out_root = str(out_root)
         self.root = os.path.join(self.out_root, QUEUE_DIRNAME)
         self.host_id = str(host_id)
         self.run_id = run_id
         self.lease_s = float(lease_s)
         self.max_reclaims = int(max_reclaims)
+        # how long a .staging/ orphan may sit before recovery sweeps it
+        # back to pending: the GC retention knob when set (gc.py), else
+        # the legacy several-lease heuristic
+        self.staging_retention_s = (
+            float(staging_retention_s) if staging_retention_s is not None
+            else STAGING_ORPHAN_LEASES * self.lease_s)
         self.journal = journal
         self.clock = clock
         self.host_dir = os.path.join(self.root, CLAIMED, _safe(self.host_id))
@@ -441,8 +451,9 @@ class WorkQueue:
     def _sweep_staging(self, now: float) -> int:
         """Recover items a stealer lost mid-reclaim (died between the
         staging rename and the pending write): anything in .staging/
-        older than several lease periods goes back to pending unless its
-        done marker exists."""
+        older than ``staging_retention_s`` (the GC retention knob, or a
+        several-lease default) goes back to pending unless its done
+        marker exists."""
         recovered = 0
         try:
             names = [n for n in os.listdir(self._p(STAGING))
@@ -455,7 +466,7 @@ class WorkQueue:
                 age = now - os.path.getmtime(path)
             except OSError:
                 continue
-            if age < STAGING_ORPHAN_LEASES * self.lease_s:
+            if age < self.staging_retention_s:
                 continue
             rec = _read_json(path)
             if rec is None or not rec.get("id"):

@@ -121,6 +121,11 @@ class AlertConfig:
     #: mfu_regression_frac x median of >= mfu_min_history prior samples
     mfu_regression_frac: float = 0.7
     mfu_min_history: int = 3
+    #: disk pressure (gc.py usage samples): fire at this fraction of the
+    #: gc_quota_gb level, or when the windowed growth rate projects the
+    #: quota full within the horizon
+    disk_pressure_frac: float = 0.9
+    disk_horizon_s: float = 3600.0
 
 
 # -- rules --------------------------------------------------------------------
@@ -406,6 +411,48 @@ def _rule_failure_spike(obs: dict, cfg: AlertConfig) -> List[dict]:
     return out
 
 
+def _rule_disk_pressure(obs: dict, cfg: AlertConfig) -> List[dict]:
+    """Burn-rate alarm on the storage accounting (gc.py GcMonitor
+    samples — heartbeat ``gc`` section, retained by history): fires at
+    ``disk_pressure_frac`` of the quota level, or earlier when the
+    windowed growth rate projects the quota full inside
+    ``disk_horizon_s`` — a full disk is a fleet-wide FATAL (ENOSPC,
+    utils/faults.py), so the page has to land while vft-gc can still
+    win the race."""
+    out: List[dict] = []
+    now = obs["time"]
+    for host, samples in sorted(obs["history"].items()):
+        used = history.latest(samples, "gc.used_bytes")
+        quota = history.latest(samples, "gc.quota_bytes")
+        if not used or not quota:
+            continue  # accounting off, or no quota configured
+        used_f, quota_f = float(used), float(quota)
+        if used_f >= cfg.disk_pressure_frac * quota_f:
+            out.append(_finding(
+                host,
+                f"disk usage {used_f / 1e9:.2f}GB at "
+                f"{100.0 * used_f / quota_f:.0f}% of the "
+                f"{quota_f / 1e9:.2f}GB quota",
+                value=used_f / quota_f,
+                threshold=cfg.disk_pressure_frac))
+            continue
+        grow = history.window_delta(samples, "gc.used_bytes", now,
+                                    cfg.spike_window_s,
+                                    allow_negative=True)
+        if grow is None or grow[0] <= 0 or grow[1] <= 0:
+            continue  # flat or shrinking (GC winning): no projection
+        rate = grow[0] / grow[1]  # bytes/s
+        ttf = (quota_f - used_f) / rate
+        if ttf < cfg.disk_horizon_s:
+            out.append(_finding(
+                host,
+                f"disk filling at {rate / 1e6:.2f}MB/s — quota "
+                f"{quota_f / 1e9:.2f}GB projected full in "
+                f"{ttf:.0f}s (< {cfg.disk_horizon_s:.0f}s horizon)",
+                value=ttf, threshold=cfg.disk_horizon_s))
+    return out
+
+
 BUILTIN_RULES: Tuple[AlertRule, ...] = (
     AlertRule("slo_burn_rate", "page",
               "multi-window serve SLO burn over the error budget",
@@ -438,6 +485,10 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
     AlertRule("mfu_regression", "ticket",
               "family MFU below its own retained history",
               _rule_mfu_regression),
+    AlertRule("disk_pressure", "page",
+              "storage usage at the quota level, or growth projecting "
+              "it full within the horizon",
+              _rule_disk_pressure),
 )
 
 

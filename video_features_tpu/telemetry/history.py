@@ -150,18 +150,31 @@ def sample_from_heartbeat(hb: dict,
     if fams:
         sample["mfu"] = {fam: f.get("mfu") for fam, f in fams.items()
                          if isinstance(f, dict)}
+    gc = hb.get("gc")
+    if isinstance(gc, dict):
+        # storage accounting (gc.py GcMonitor): the disk_pressure rule
+        # reads used/quota levels and diffs used_bytes across windows to
+        # project time-to-full
+        sample["gc"] = {
+            "used_bytes": int(gc.get("used_bytes") or 0),
+            "quota_bytes": (int(gc["quota_bytes"])
+                            if gc.get("quota_bytes") else None),
+        }
     return sample
 
 
 # -- tiered downsampling -----------------------------------------------------
 
 def downsample(samples: Sequence[dict],
-               now: Optional[float] = None) -> List[dict]:
-    """Apply :data:`TIERS` to a time-sorted sample list: within each
-    tier, keep the LAST sample of every ``period``-wide bucket (the
-    freshest state of that interval — windowed deltas read end-of-bucket
-    counters); drop samples older than the final tier. Pure function, so
-    tests drive it with a fake clock."""
+               now: Optional[float] = None, *,
+               tiers: Sequence[Tuple[float, float]] = TIERS) -> List[dict]:
+    """Apply ``tiers`` (default :data:`TIERS`) to a time-sorted sample
+    list: within each tier, keep the LAST sample of every
+    ``period``-wide bucket (the freshest state of that interval —
+    windowed deltas read end-of-bucket counters); drop samples older
+    than the final tier. Pure function, so tests drive it with a fake
+    clock — and scripts/bench_history.py reuses it with bench-cadence
+    tiers instead of copying the algorithm."""
     now = time.time() if now is None else float(now)
     kept: List[dict] = []
     buckets_seen: Dict[Tuple[int, int], int] = {}
@@ -172,7 +185,7 @@ def downsample(samples: Sequence[dict],
         t = _num(s.get("time"))
         age = now - t
         tier = None
-        for i, (max_age, period) in enumerate(TIERS):
+        for i, (max_age, period) in enumerate(tiers):
             if age <= max_age:
                 tier = (i, period)
                 break
@@ -208,12 +221,26 @@ class HistoryWriter:
         self.clock = clock
         self._appends_since_compact = 0
         self._recorder = None
+        # degradation latch (ENOSPC discipline): one failed append or
+        # compaction disables the retention pillar for the run — the
+        # alert windows go quiet, the extraction does not die
+        self._disabled = False
 
     def observe(self, sample: dict) -> None:
-        jsonl.append_jsonl(self.path, sample)
-        self._appends_since_compact += 1
-        if self._appends_since_compact >= COMPACT_EVERY:
-            self.compact()
+        if self._disabled:
+            return
+        try:
+            jsonl.append_jsonl(self.path, sample)
+            self._appends_since_compact += 1
+            if self._appends_since_compact >= COMPACT_EVERY:
+                self.compact()
+        except OSError as e:
+            self._disabled = True
+            from . import inc
+            inc("vft_telemetry_write_failures_total", pillar="history")
+            print(f"telemetry: failed to append {self.path} "
+                  f"({type(e).__name__}: {e}) — history retention "
+                  "disabled for this run")
 
     def compact(self, now: Optional[float] = None) -> int:
         """Rewrite the file through :func:`downsample` (atomic temp +

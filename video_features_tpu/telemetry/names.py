@@ -123,6 +123,20 @@ METRICS = {
     "vft_roofline_effective_tflops": "gauge",
     "vft_roofline_dispatches_total": "counter",
     "vft_roofline_peak_tflops": "gauge",
+
+    # -- telemetry writer self-health (recorder/history/trace pillars) ------
+    "vft_telemetry_write_failures_total": "counter",
+
+    # -- storage lifecycle plane (gc.py via vft-gc / vft-fleet) -------------
+    "vft_gc_plane_bytes": "gauge",
+    "vft_gc_tenant_bytes": "gauge",
+    "vft_gc_used_bytes": "gauge",
+    "vft_gc_quota_bytes": "gauge",
+    "vft_gc_evicted_total": "counter",
+    "vft_gc_evicted_bytes_total": "counter",
+    "vft_gc_retained_total": "counter",
+    "vft_gc_sweeps_total": "counter",
+    "vft_gc_sweep_errors_total": "counter",
 }
 
 

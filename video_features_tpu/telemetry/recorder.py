@@ -150,6 +150,9 @@ class TelemetryRecorder:
         # what gives short runs a windowed baseline at all
         self.tick_hooks: List[Callable[[dict], None]] = []
         self._tick_hook_errors = 0
+        # span-channel degradation latch (ENOSPC discipline): a failed
+        # _telemetry.jsonl append disables the pillar for the run
+        self._spans_disabled = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "TelemetryRecorder":
@@ -199,7 +202,19 @@ class TelemetryRecorder:
                          host_id=self.host_id)
 
     def emit_span(self, record: dict) -> None:
-        jsonl.append_jsonl(self.spans_path, record)
+        if not self._spans_disabled:
+            try:
+                jsonl.append_jsonl(self.spans_path, record)
+            except OSError as e:
+                # a full/readonly disk (ENOSPC) must degrade this pillar,
+                # not kill the extraction: drop the span channel for the
+                # rest of the run, keep the in-memory counters flowing
+                self._spans_disabled = True
+                self.registry.counter("vft_telemetry_write_failures_total",
+                                      pillar="spans").inc()
+                print(f"telemetry: failed to append {self.spans_path} "
+                      f"({type(e).__name__}: {e}) — span channel disabled "
+                      "for this run")
         status = record.get("status", "?")
         self.registry.counter("vft_videos_total", status=status).inc()
         self.registry.histogram("vft_video_wall_seconds",

@@ -271,6 +271,11 @@ class TraceRecorder:
                                     indent=None)
             return self.trace_path
         except Exception as e:
+            # ENOSPC discipline: a failed trace drain is the loss of one
+            # diagnostic artifact, never a crashed run — named once, and
+            # counted on the active recorder when there is one
+            from . import inc
+            inc("vft_telemetry_write_failures_total", pillar="trace")
             print(f"trace: failed to write {self.trace_path}: "
                   f"{type(e).__name__}: {e}")
             return None

@@ -97,6 +97,9 @@ SITES = (
     "gateway.spool_submit",  # gateway.py _submit_to_spool, pre-rename
     "heartbeat.tick",       # telemetry/heartbeat.py HeartbeatThread._run
     "worker.kill",          # utils/sinks.py safe_extract, per attempt
+    "gc.evict",             # gc.py execute, between the journal append
+                            # and the unlink
+    "gc.sweep",             # gc.py sweep, per accounting+eviction pass
 )
 
 #: raise-kind faults -> the errno they raise with (None = RuntimeError)
@@ -118,10 +121,10 @@ FAULT_KINDS = tuple(_RAISE_ERRNO) + _BEHAVIORAL + ("kill",)
 _BEHAVIORAL_SITES = {
     "torn": ("sink.tmp_write", "cache.lookup", "gateway.read"),
     "drop": ("sink.rename", "queue.steal_staging", "gateway.spool_submit",
-             "spool.respond"),
+             "spool.respond", "gc.evict"),
     "skew": ("queue.claim",),
     "freeze": ("heartbeat.tick",),
-    "stall": ("gateway.read",),
+    "stall": ("gateway.read", "gc.sweep"),
 }
 
 

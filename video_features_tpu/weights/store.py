@@ -23,6 +23,7 @@ story is:
 from __future__ import annotations
 
 import os
+import time
 from pathlib import Path
 from typing import Any, Callable, Dict, Optional
 
@@ -172,6 +173,36 @@ def record_digest(fname: str, sha256: str) -> None:
     os.replace(tmp, path)
 
 
+#: a ``.part`` download older than this is litter from a fetcher that
+#: died mid-stream (SIGKILL skips every unlink-on-failure handler) —
+#: no live download runs this long, so the next fetch sweeps it
+PART_STALE_S = 3600.0
+
+
+def sweep_stale_parts(wd: Path, *, now: Optional[float] = None,
+                      stale_s: float = PART_STALE_S) -> int:
+    """Delete ``*.part`` temp files older than ``stale_s``. Young parts
+    are left alone — a concurrent fetcher may still be streaming into
+    them (the mkstemp names are per-process unique, so deleting someone
+    else's LIVE part would fail their promote). Returns the count."""
+    now = time.time() if now is None else float(now)
+    swept = 0
+    try:
+        parts = sorted(Path(wd).glob("*.part"))
+    except OSError:
+        return 0
+    for p in parts:
+        try:
+            if now - p.stat().st_mtime < stale_s:
+                continue
+            p.unlink()
+            swept += 1
+            print(f"weights: swept stale download litter {p.name}")
+        except OSError:
+            pass  # a sibling sweeper won the race, or perms: both fine
+    return swept
+
+
 def fetch_checkpoint(model_key: str) -> Optional[Path]:
     """Download ``model_key``'s upstream checkpoint into ``weights_dir()``,
     verifying the published SHA-256 while streaming. Mirrors the
@@ -187,6 +218,8 @@ def fetch_checkpoint(model_key: str) -> Optional[Path]:
     import hashlib
     import urllib.request
     wd = weights_dir()
+    if wd.is_dir():
+        sweep_stale_parts(wd)
     for fname in HUB_FILENAMES.get(model_key, ()):
         url = WEIGHT_URLS.get(fname)
         if url is None:

@@ -411,9 +411,11 @@ def test_sample_from_heartbeat_fields():
     assert s["compile_cache"] == {"hits": 7, "misses": 0}
     assert s["fleet"]["queue"]["pending"] == 4
     assert s["slo"] == {"slo_s": 1.0, "requests": 10, "violations": 3}
-    # per-tenant counters ride along for the tenant-scoped burn windows
+    # per-tenant counters ride along for the tenant-scoped burn windows,
+    # plus the derived attainment the scenario curves join against
     # (rejects are door-state, not SLO state: not sampled)
-    assert s["tenants"] == {"alpha": {"requests": 7, "violations": 1}}
+    assert s["tenants"] == {"alpha": {"requests": 7, "violations": 1,
+                                      "attainment_pct": 85.71}}
     assert s["mfu"] == {"r21d": 0.61}
     assert s["nonfinite_total"] == 2
     json.dumps(s)  # JSON-safe by construction

@@ -121,7 +121,7 @@ def make_repo(tmp_path: Path) -> Path:
         | `sink.write` | sinks |
         """)
 
-    # schema-lockstep contract modules + JSONs (all four pairs)
+    # schema-lockstep contract modules + JSONs (all the checked pairs)
     _w(root, f"{pkg}/telemetry/spans.py", """\
         SCHEMA_VERSION = "vft.video_span/1"
         STATUSES = ("done", "error")
@@ -154,6 +154,23 @@ def make_repo(tmp_path: Path) -> Path:
                        "state": {"enum": ["pending", "firing",
                                           "resolved"]},
                        "severity": {"enum": ["page", "ticket"]}},
+        "required": ["schema"], "additionalProperties": False})
+
+    _w(root, f"{pkg}/loadgen.py", """\
+        SCHEMA_VERSION = "vft.loadgen_event/1"
+        SCENARIO_SCHEMA = "vft.scenario/1"
+        EVENTS = ("begin", "request", "end")
+        VERDICTS = ("PASS", "FAIL")
+        LOADGEN_FIELDS = ("schema", "event")
+        SCENARIO_FIELDS = ("schema", "verdict")
+        """)
+    _wj(root, f"{pkg}/telemetry/loadgen_event.schema.json", {
+        "properties": {"schema": {"enum": ["vft.loadgen_event/1"]},
+                       "event": {"enum": ["begin", "request", "end"]}},
+        "required": ["schema"], "additionalProperties": False})
+    _wj(root, f"{pkg}/telemetry/scenario.schema.json", {
+        "properties": {"schema": {"enum": ["vft.scenario/1"]},
+                       "verdict": {"enum": ["PASS", "FAIL"]}},
         "required": ["schema"], "additionalProperties": False})
 
     _w(root, f"{pkg}/telemetry/roofline.py", """\

@@ -623,6 +623,65 @@ class Audit:
                 "vft-gc run re-plans and completes them (recoverable)")
         self.stats["gc_journal_records"] = n_records
 
+    def check_scenarios(self) -> None:
+        """Invariant 12: every ``_scenario.json`` drill verdict
+        (loadgen.py) is internally consistent and consistent with the
+        loadgen journal it names — the offered count must equal the
+        journal's request events (the artifact may not claim traffic the
+        deterministic record doesn't show), per-tenant tallies must sum
+        to the headline numbers, and a PASS verdict may not sit on top
+        of a recorded audit failure."""
+        from .loadgen import SCENARIO_SCHEMA
+        from .telemetry.jsonl import read_jsonl
+        n = 0
+        for sp in sorted(self.root.rglob("_scenario.json")):
+            doc = self._read_json(sp)
+            if doc is None:
+                self.violation(f"{self._rel(sp)}: unreadable")
+                continue
+            n += 1
+            if doc.get("schema") != SCENARIO_SCHEMA:
+                self.violation(
+                    f"{self._rel(sp)}: schema {doc.get('schema')!r} != "
+                    f"{SCENARIO_SCHEMA!r}")
+                continue
+            tens = doc.get("tenants") or {}
+            for k in ("offered", "admitted", "completed", "expired",
+                      "rejected", "shed", "errors"):
+                want = sum(int(tb.get(k) or 0) for tb in tens.values())
+                if int(doc.get(k) or 0) != want:
+                    self.violation(
+                        f"{self._rel(sp)}: headline {k}="
+                        f"{doc.get(k)} != per-tenant sum {want}")
+            parts = sum(int(doc.get(k) or 0)
+                        for k in ("admitted", "rejected", "shed",
+                                  "errors"))
+            if parts != int(doc.get("offered") or 0):
+                self.violation(
+                    f"{self._rel(sp)}: admitted+rejected+shed+errors="
+                    f"{parts} != offered={doc.get('offered')} — every "
+                    "offered request has exactly one door outcome")
+            jp = sp.parent / str(doc.get("journal") or "")
+            if doc.get("journal") and jp.is_file():
+                reqs = sum(1 for rec in read_jsonl(jp)
+                           if rec.get("event") == "request")
+                if reqs != int(doc.get("offered") or 0):
+                    self.violation(
+                        f"{self._rel(sp)}: offered={doc.get('offered')} "
+                        f"but the loadgen journal {jp.name} records "
+                        f"{reqs} request event(s)")
+            elif doc.get("journal"):
+                self.note(f"{self._rel(sp)}: journal "
+                          f"{doc.get('journal')} not found beside the "
+                          "artifact — offered count unverifiable")
+            if doc.get("verdict") == "PASS" and \
+                    not (doc.get("audit") or {}).get("pass"):
+                self.violation(
+                    f"{self._rel(sp)}: verdict PASS over a recorded "
+                    "audit failure — the drill gate requires both")
+        if n:
+            self.stats["scenario_artifacts"] = n
+
     # -- driver --------------------------------------------------------------
     def run(self) -> bool:
         if not self.root.is_dir():
@@ -638,6 +697,7 @@ class Audit:
         self.check_artifact_spans()
         self.check_cache()
         self.check_gc()
+        self.check_scenarios()
         return not self.violations
 
 

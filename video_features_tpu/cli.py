@@ -112,6 +112,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         # `vft-gateway` console script)
         from .gateway import gateway_main
         return gateway_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # traffic drills: `python main.py loadgen scenarios/steady.yml
+        # --spool ... --base-url ...` replays a seeded scenario against
+        # the gateway and publishes the _scenario.json verdict
+        # (loadgen.py; also installed as the `vft-loadgen` console
+        # script). Exits with the drill verdict.
+        from .loadgen import loadgen_main
+        raise SystemExit(loadgen_main(argv[1:]))
     if argv and argv[0] == "lint":
         # contract-aware static analysis: `python main.py lint [--json
         # --baseline ...]` proves the repo's cross-file invariants in

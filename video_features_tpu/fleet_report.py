@@ -268,6 +268,24 @@ def collect_alerts(root: str) -> List[dict]:
         return []
 
 
+def collect_scenarios(root: str) -> List[dict]:
+    """Every recorded-drill verdict under the root (``_scenario.json``,
+    loadgen.py): the traffic-scenario observatory — rendered as the
+    ``== scenarios ==`` section and exported as ``vft_scenario_*``
+    gauges. Sorted by artifact time so the freshest drill renders
+    last."""
+    out: List[dict] = []
+    for p in sorted(Path(str(root)).rglob("_scenario.json")):
+        if _in_incident(p):
+            continue
+        doc = _load_json(str(p))
+        if doc is not None and \
+                str(doc.get("schema", "")).startswith("vft.scenario/"):
+            out.append(doc)
+    out.sort(key=lambda d: float(d.get("time") or 0.0))
+    return out
+
+
 def aggregate(root: str, now: Optional[float] = None) -> dict:
     """The one-view fleet snapshot: everything the renderer, the prom
     exporter and the tests consume, as plain JSON-safe data."""
@@ -409,6 +427,9 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         # storage accounting (gc.py): the freshest host's usage snapshot
         # of the shared planes; None when no host ran with gc=true
         "gc": gc_section,
+        # recorded traffic drills (loadgen.py): each _scenario.json
+        # verdict with its windowed SLO-attainment curve
+        "scenarios": collect_scenarios(root),
     }
 
 
@@ -826,6 +847,57 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
             if tt.get("attainment_pct") is not None:
                 line += f"  attainment={tt['attainment_pct']}%"
             lines.append(line)
+    for sc in agg.get("scenarios") or []:
+        lines += render_scenario(sc)
+    return lines
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(vals: List[Optional[float]]) -> str:
+    """Attainment-curve sparkline: 0..100% maps onto 8 block heights
+    (absolute scale, so two drills' curves compare at a glance); a
+    window with no admitted traffic renders as '·'."""
+    out = []
+    for v in vals:
+        if v is None:
+            out.append("·")
+        else:
+            out.append(_SPARK[max(0, min(7, int(float(v) / 100.0 * 7.999)))])
+    return "".join(out)
+
+
+def render_scenario(sc: dict) -> List[str]:
+    """The ``== scenarios ==`` block for one drill verdict: headline
+    tallies, then one line per tenant with its windowed SLO-attainment
+    curve over the scenario timeline."""
+    lines = [f"== scenarios ==  {sc.get('scenario')}: "
+             f"{sc.get('verdict')}  "
+             f"offered={sc.get('offered', 0)}  "
+             f"admitted={sc.get('admitted', 0)}  "
+             f"completed={sc.get('completed', 0)}  "
+             f"expired={sc.get('expired', 0)}  "
+             f"429={sc.get('rejected', 0)}  shed={sc.get('shed', 0)}"
+             + (f"  [audit FAIL]"
+                if not (sc.get("audit") or {}).get("pass", True) else "")]
+    curve = sc.get("curve") or []
+    for t, tb in sorted((sc.get("tenants") or {}).items()):
+        vals = [(w.get("tenants") or {}).get(t, {}).get("attainment_pct")
+                for w in curve]
+        line = (f"  {t:<12} attainment="
+                + (f"{tb['attainment_pct']}%"
+                   if tb.get("attainment_pct") is not None else "n/a"))
+        if curve:
+            line += (f"  curve={_spark(vals)} "
+                     f"({curve[0].get('t1', 0)}s windows, virtual)")
+        lines.append(line)
+    unmet = [o for o in sc.get("objectives") or [] if not o.get("met")]
+    for o in unmet:
+        what = next((k for k in o if k.startswith(("min_", "max_"))), "?")
+        scope = f"tenant={o['tenant']} " if o.get("tenant") else ""
+        lines.append(f"  UNMET: {scope}{what}={o.get(what)} "
+                     f"actual={o.get('actual')}")
     return lines
 
 
@@ -922,6 +994,16 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
               host_id=h["host_id"], quantile=p)
             g("vft_fleet_serve_queue_wait_seconds", qw.get(p),
               host_id=h["host_id"], quantile=p)
+    for sc in agg.get("scenarios") or []:
+        name = sc.get("scenario")
+        g("vft_scenario_pass", 1 if sc.get("verdict") == "PASS" else 0,
+          scenario=name)
+        for k in ("offered", "admitted", "completed", "expired",
+                  "rejected", "shed"):
+            g(f"vft_scenario_{k}", sc.get(k, 0), scenario=name)
+        for t, tb in sorted((sc.get("tenants") or {}).items()):
+            g("vft_scenario_attainment_pct", tb.get("attainment_pct"),
+              scenario=name, tenant=t)
     if agg.get("alerts"):
         # ALERTS{alertname, alertstate, severity, scope} 1 — the exact
         # series shape Prometheus-native alert evaluators export, so

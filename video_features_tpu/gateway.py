@@ -396,6 +396,25 @@ class GatewayServer:
         except OSError:
             return 0
 
+    def _backlog_wait_s(self, klass: str) -> float:
+        """Estimated seconds until the release loop drains what is
+        queued ahead of a new ``klass`` request: queue depth over the
+        class's weighted-fair share of the per-tick spool budget. This
+        is the backlog half of an honest 429 Retry-After — refill alone
+        tells a client when it has a TOKEN, not when the edge queue has
+        ROOM, so under backlog refill-only retries thunder back into
+        the same full queue."""
+        with self._lock:
+            depths = {c: len(q) for c, q in self._queues.items()}
+        qlen = depths.get(klass, 0)
+        if not qlen:
+            return 0.0
+        active = [c for c, d in depths.items() if d]
+        share = PRIORITY_WEIGHTS[klass] / float(
+            sum(PRIORITY_WEIGHTS[c] for c in active))
+        per_tick = max(1.0, self.spool_bound * share)
+        return qlen / per_tick * self.poll_s
+
     def _shed_reason(self) -> Optional[str]:
         """503-worthy overload, from signals the spool already exports:
         a full edge queue, or a backend the heartbeats say is DEAD
@@ -421,7 +440,9 @@ class GatewayServer:
         rid = f"{tenant.name}-{uuid.uuid4().hex[:12]}"
         ok, retry_after = self._bucket(tenant).try_take()
         if not ok:
-            retry = max(1, int(retry_after + 0.999))
+            retry = max(1, int(retry_after
+                               + self._backlog_wait_s(tenant.priority)
+                               + 0.999))
             self._tally(tenant.name, "rejected")
             self._j("rejected", id=rid, tenant=tenant.name, reason="rate",
                     retry_after_s=retry)
@@ -434,7 +455,9 @@ class GatewayServer:
         with self._lock:
             inflight = self._inflight.get(tenant.name, 0)
         if inflight >= tenant.max_inflight:
-            retry = max(1, int(self.poll_s * 4 + 0.999))
+            retry = max(1, int(self.poll_s * 4
+                               + self._backlog_wait_s(tenant.priority)
+                               + 0.999))
             self._tally(tenant.name, "rejected")
             self._j("rejected", id=rid, tenant=tenant.name,
                     reason="inflight", retry_after_s=retry)

@@ -716,6 +716,23 @@ def check_schema_lockstep(ctx: LintContext) -> List[Finding]:
         ctx.line_of(rel, "ALERT_FIELDS = "),
         {"state": "STATES", "severity": "SEVERITIES"})
 
+    # loadgen <-> loadgen_event.schema.json + scenario.schema.json
+    # (two record shapes, one emitter module — the journal record and
+    # the exit-join verdict artifact; the scenario schema's version tag
+    # lives in SCENARIO_SCHEMA, not SCHEMA_VERSION, hence the override)
+    rel = "video_features_tpu/loadgen.py"
+    consts, _ = consts_of(rel)
+    findings += _schema_checks(
+        ctx, "loadgen_event",
+        ctx.load_json(tel + "loadgen_event.schema.json"),
+        rel, consts, "LOADGEN_FIELDS",
+        ctx.line_of(rel, "LOADGEN_FIELDS = "), {"event": "EVENTS"})
+    findings += _schema_checks(
+        ctx, "scenario", ctx.load_json(tel + "scenario.schema.json"),
+        rel, dict(consts, SCHEMA_VERSION=consts.get("SCENARIO_SCHEMA")),
+        "SCENARIO_FIELDS", ctx.line_of(rel, "SCENARIO_FIELDS = "),
+        {"verdict": "VERDICTS"})
+
     # roofline <-> roofline.schema.json (nested)
     rel = tel + "roofline.py"
     consts, _ = consts_of(rel)

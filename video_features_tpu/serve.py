@@ -551,6 +551,14 @@ class ServeLoop:
         telemetry.inc("vft_serve_deadline_exceeded_total")
         with self._state_lock:
             self._tallies["deadline_exceeded"] += 1
+            # an expired request IS an answered-and-violated request for
+            # attainment purposes: without these, deadline-heavy load makes
+            # attainment_pct overstate health (the fleet-wide block would
+            # only ever see the requests that finished in time)
+            self._answered += 1
+            self._slo_violations += 1
+        self.recorder.registry.counter(
+            "vft_serve_slo_violations_total").inc()
         self._tenant_bump(tenant, "requests")
         self._tenant_bump(tenant, "violations")
         try:

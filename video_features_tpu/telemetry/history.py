@@ -76,6 +76,12 @@ def _num(v, default=0.0) -> float:
         return default
 
 
+def _attainment(requests: int, violations: int) -> Optional[float]:
+    if not requests:
+        return None
+    return round(100.0 * (requests - violations) / requests, 2)
+
+
 def sample_from_heartbeat(hb: dict,
                           nonfinite_total: Optional[int] = None) -> dict:
     """Compact, JSON-safe sample off one heartbeat dict: cumulative
@@ -141,9 +147,15 @@ def sample_from_heartbeat(hb: dict,
             # SLO burn windows diff (telemetry/alerts.py). Tenant names
             # are [a-z0-9_]+ (gateway.py), so the dotted-path readers
             # (`_field`) can address them safely
+            # cumulative attainment rides along so scenario curves
+            # (loadgen.py) can be rebuilt from retained history alone
+            # after the run — per-tenant was heartbeat-only before
             sample["tenants"] = {
                 str(t): {"requests": int(v.get("requests") or 0),
-                         "violations": int(v.get("violations") or 0)}
+                         "violations": int(v.get("violations") or 0),
+                         "attainment_pct": _attainment(
+                             int(v.get("requests") or 0),
+                             int(v.get("violations") or 0))}
                 for t, v in tens.items()}
     rf = hb.get("roofline") or {}
     fams = rf.get("families") if isinstance(rf, dict) else None

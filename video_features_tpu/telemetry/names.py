@@ -118,6 +118,22 @@ METRICS = {
     "vft_fleet_serve_queue_wait_seconds": "gauge",
     "vft_tenant_slo_attainment_pct": "gauge",
 
+    # -- traffic scenarios (loadgen.py; vft-fleet == scenarios == + --prom) -
+    "vft_loadgen_offered_total": "counter",
+    "vft_loadgen_admitted_total": "counter",
+    "vft_loadgen_rejected_total": "counter",
+    "vft_loadgen_shed_total": "counter",
+    "vft_loadgen_completed_total": "counter",
+    "vft_loadgen_expired_total": "counter",
+    "vft_scenario_pass": "gauge",
+    "vft_scenario_offered": "gauge",
+    "vft_scenario_admitted": "gauge",
+    "vft_scenario_completed": "gauge",
+    "vft_scenario_expired": "gauge",
+    "vft_scenario_rejected": "gauge",
+    "vft_scenario_shed": "gauge",
+    "vft_scenario_attainment_pct": "gauge",
+
     # -- roofline observatory (telemetry/roofline.py via vft-fleet) ---------
     "vft_roofline_mfu": "gauge",
     "vft_roofline_effective_tflops": "gauge",

@@ -586,6 +586,54 @@ def bench_health_overhead(families=("resnet", "clip", "s3d"),
             "overhead_ratio": round(on / off, 3)}
 
 
+def bench_parity_overhead(families=("resnet", "clip", "s3d"),
+                          n_copies: int = 2) -> dict:
+    """Wall-clock cost of parity=true (telemetry/parity.py) on the same
+    smoke corpus as bench_trace_overhead: the multi-family CLI run,
+    warmed untimed, then timed with parity=false and parity=true into
+    fresh output dirs. The instrumented paths are the transform-seam
+    wrapper (two digests per frame, bounded at 4 per seam/key) plus one
+    digest per backbone batch and head key; past the per-key bound every
+    tap is a single counter check — the acceptance bar is <= 1.05x like
+    the other observability knobs."""
+    import contextlib
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    sample = Path(__file__).parent / "tests" / "assets" / "v_synth_sample.mp4"
+    if not sample.exists():
+        sample = Path("/root/reference/sample/v_GGSY1Qvo990.mp4")
+    if not sample.exists():
+        raise FileNotFoundError("no sample video for the parity bench")
+    from video_features_tpu.cli import main as cli_main
+    base = ["allow_random_weights=true", "on_extraction=save_numpy",
+            "extraction_fps=4", "batch_size=32"]
+    with tempfile.TemporaryDirectory(prefix="vft_bench_parity_") as td:
+        vids = []
+        for i in range(n_copies):
+            dst = Path(td) / f"sample_parity{i}.mp4"
+            shutil.copy(sample, dst)
+            vids.append(str(dst))
+
+        def run(out: str, extra) -> float:
+            argv = [f"feature_type={','.join(families)}",
+                    f"output_path={td}/{out}", f"tmp_path={td}/tmp",
+                    "video_paths=[" + ",".join(vids) + "]"] + base + extra
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(_sys.stderr):
+                cli_main(argv)
+            return time.perf_counter() - t0
+
+        run("warm", [])  # weights, compiles, persistent cache
+        off = run("off", ["parity=false"])
+        on = run("on", ["parity=true"])
+    return {"families": list(families), "n_copies": n_copies,
+            "off_s": round(off, 2), "on_s": round(on, 2),
+            "overhead_ratio": round(on / off, 3)}
+
+
 def bench_roofline_overhead(families=("resnet", "clip", "s3d"),
                             n_copies: int = 2) -> dict:
     """Wall-clock cost of roofline=true (telemetry/roofline.py) on the
@@ -2155,6 +2203,28 @@ def main() -> None:
         })
     except Exception as e:
         print(f"WARNING: health-overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # parity=true wall-clock tax (telemetry/parity.py seam digests): the
+    # sixth observability knob held to the same <= 1.05x budget,
+    # bench-history gated — the off path must stay one global read
+    try:
+        po = bench_parity_overhead()
+        metrics.append({
+            "metric": "parity observatory overhead (parity=true vs off, "
+                      f"{'+'.join(po['families'])})",
+            "value": po["overhead_ratio"],
+            "unit": "x wall-clock",
+            "vs_baseline": None,
+            "off_s": po["off_s"],
+            "on_s": po["on_s"],
+            "note": f"{po['n_copies']}x sample, extraction_fps=4, warmed, "
+                    "fresh outputs; decode/transform digests in the "
+                    "TransformTap wrapper (bounded per seam/key) plus "
+                    "backbone/head digests at the batch boundary are the "
+                    "instrumented paths (docs/numerics.md)",
+        })
+    except Exception as e:
+        print(f"WARNING: parity-overhead bench failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # roofline accounting (telemetry/roofline.py): one AOT lowering per
     # program shape + a dict hit per dispatch + the chained stage hook —

@@ -17,9 +17,18 @@ Also asserts the new default run still emits schema-valid health + trace
 artifacts (the check_*_schema gates run the same defaults elsewhere in
 the quick job — this script pins the A/B).
 
-Exit 0 = flip is digest-stable; exit 1 = drift itemized by compare_runs.
-Runs on CPU in the quick CI tier (~a minute: random weights, tiny frame
-budget).
+PR 19 flipped ``precision`` in ``raft.yml``/``pwc.yml`` from ``float32``
+to ``bfloat16`` (the measured 64→152 / 75→123 pairs/s MXU wins,
+ROADMAP item 2), carrying committed ``evidence/parity/*_bf16/``
+verdicts. This gate re-certifies the raft flip live on every CI run:
+``vft-parity certify --flip dtype=bf16`` (telemetry/parity.py) runs the
+pinned-f32 reference arm against the bf16 candidate arm and must PASS
+per seam against the tolerance registry — so the dtype default can
+never outlive its evidence.
+
+Exit 0 = flips are digest-stable; exit 1 = drift itemized by
+compare_runs / the certify verdict. Runs on CPU in the quick CI tier
+(a few minutes: random weights, tiny frame budget).
 """
 from __future__ import annotations
 
@@ -71,9 +80,26 @@ def main() -> int:
                   "the atol=1e-2 health-digest bands vs resize=host "
                   "(compare_runs output above)")
             return 1
+
+        # dtype-flip A/B: the committed raft bf16 default must keep
+        # certifying against a pinned-f32 reference arm, seam by seam
+        from video_features_tpu.telemetry import parity
+        with contextlib.redirect_stdout(sys.stderr):
+            doc = parity.certify("raft", flip="dtype=bf16",
+                                 videos=[str(SAMPLE)], frames=6,
+                                 out_dir=str(Path(td) / "cert"))
+        if doc.get("verdict") != "PASS":
+            print("defaults-flip gate FAIL: the raft bf16 default no "
+                  "longer certifies against float32 — first drifted "
+                  f"seam: {doc.get('first_drift')} "
+                  f"(seams: { {s: m.get('max_abs') for s, m in (doc.get('seams') or {}).items()} }); "
+                  "re-run `vft-parity certify --config raft.yml --flip "
+                  "dtype=bf16` and see docs/numerics.md")
+            return 1
     print("defaults-flip gate OK: resize=auto (device) save run is "
           "digest-stable vs the old resize=host default under the stock "
-          "compare_runs bands")
+          "compare_runs bands; raft dtype=bf16 default re-certified "
+          "PASS per seam")
     return 0
 
 

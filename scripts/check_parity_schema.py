@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Parity quick-gate: emitter and JSON Schemas agree, and a real
+``parity=true`` CPU smoke plus an identity certify produce valid
+artifacts.
+
+Sibling of ``check_health_schema.py``, for the per-seam numerics
+observatory (telemetry/parity.py). The *static* lockstep halves
+(``PARITY_FIELDS``/``VERDICT_FIELDS`` == schema properties, required ⊆
+properties, the seam/verdict enums) run in ``vft-lint`` rule **VFT006**;
+this script keeps what the lint cannot see:
+
+  1. **synthetic**: a seam digest of a real tensor has exactly the
+     declared keys and validates via the dependency-free validator
+     (telemetry/schema.py); the tolerance registry self-validates;
+  2. **smoke**: a single-family resnet CPU run over the vendored sample
+     with ``parity=true`` must append valid records covering all four
+     seams to ``_parity.jsonl`` and surface a heartbeat ``parity``
+     section;
+  3. **certify**: an in-process identity A/B (no flip — both arms run
+     the YAML defaults) over the same sample must emit a
+     schema-valid ``_parity_verdict.json`` with verdict PASS — the
+     two-arm harness itself is what this proves out.
+
+Exit 0 = in sync; exit 1 = drift, every violation listed. Runs in the
+CI quick tier (.github/workflows/ci.yml).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+from typing import List
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import numpy as np  # noqa: E402
+
+from video_features_tpu.telemetry import parity  # noqa: E402
+from video_features_tpu.telemetry.jsonl import read_jsonl  # noqa: E402
+
+SAMPLE = REPO_ROOT / "tests" / "assets" / "v_synth_sample.mp4"
+
+
+def check_static() -> List[str]:
+    # (properties/required/enum lockstep is vft-lint VFT006's job now —
+    # but a torn/empty/missing schema file must still fail HERE with a
+    # one-line violation, not a traceback)
+    for loader, path in ((parity.load_parity_schema,
+                          parity.PARITY_SCHEMA_PATH),
+                         (parity.load_verdict_schema,
+                          parity.VERDICT_SCHEMA_PATH)):
+        try:
+            loader()
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"cannot load {path}: {type(e).__name__}: {e}"]
+    errs: List[str] = []
+
+    # synthetic digest: every seam emits exactly PARITY_FIELDS, valid
+    arr = np.linspace(-1, 1, 48, dtype=np.float32).reshape(4, 12)
+    for seam in parity.SEAMS:
+        rec = parity.digest_seam(seam, "feat", arr, video="check.mp4",
+                                 feature_type="check", index=0)
+        if tuple(rec) != parity.PARITY_FIELDS:
+            errs.append(f"{seam} record keys {list(rec)} differ from "
+                        "PARITY_FIELDS (order included)")
+        errs.extend(f"{seam}: {e}" for e in parity.validate_parity(rec))
+
+    # the tolerance registry must self-validate (numeric bounds, known
+    # seams, written justifications, '*' defaults)
+    errs.extend(parity.validate_tolerances())
+    return errs
+
+
+def check_smoke() -> List[str]:
+    if not SAMPLE.exists():
+        print(f"parity smoke SKIP: vendored sample missing at {SAMPLE}")
+        return []
+    from video_features_tpu.cli import main as cli_main
+    errs: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="vft_parity_gate_") as td:
+        out, tmp = Path(td) / "out", Path(td) / "tmp"
+        with contextlib.redirect_stdout(sys.stderr):
+            cli_main([
+                "feature_type=resnet", "model_name=resnet18", "device=cpu",
+                "allow_random_weights=true", "on_extraction=save_numpy",
+                "batch_size=8", "extraction_total=6", "retry_attempts=1",
+                f"output_path={out}", f"tmp_path={tmp}",
+                f"video_paths={SAMPLE}",
+                "parity=true", "telemetry=true", "metrics_interval_s=60",
+            ])
+        run_dir = out / "resnet" / "resnet18"
+        ppath = run_dir / parity.PARITY_FILENAME
+        if not ppath.exists():
+            return [f"{ppath} was not written by the parity=true smoke"]
+        recs = list(read_jsonl(ppath))
+        if not recs:
+            errs.append(f"{ppath} holds no parseable records")
+        for i, rec in enumerate(recs):
+            for e in parity.validate_parity(rec):
+                errs.append(f"record #{i}: {e}")
+        seams_seen = {rec.get("seam") for rec in recs}
+        missing = set(parity.SEAMS) - seams_seen
+        if missing:
+            errs.append(f"smoke journal never tapped seam(s) "
+                        f"{sorted(missing)} — the pipeline taps drifted")
+        hbs = sorted(run_dir.glob("_heartbeat*.json"))
+        if not hbs:
+            errs.append("no heartbeat file from the smoke run")
+        else:
+            hb = json.load(open(hbs[0]))
+            sec = hb.get("parity")
+            if not sec or not sec.get("records"):
+                errs.append(f"heartbeat 'parity' section empty ({sec!r}) "
+                            "despite journaled records")
+    return errs
+
+
+def check_certify() -> List[str]:
+    if not SAMPLE.exists():
+        print(f"parity certify SKIP: vendored sample missing at {SAMPLE}")
+        return []
+    errs: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="vft_parity_cert_") as td:
+        with contextlib.redirect_stdout(sys.stderr):
+            doc = parity.certify("resnet", flip=None,
+                                 videos=[str(SAMPLE)], frames=6,
+                                 out_dir=td)
+        vpath = Path(td) / parity.VERDICT_FILENAME
+        if not vpath.exists():
+            errs.append(f"certify wrote no {parity.VERDICT_FILENAME}")
+        else:
+            on_disk = json.load(open(vpath))
+            errs.extend(f"verdict: {e}"
+                        for e in parity.validate_verdict(on_disk))
+        if doc.get("verdict") != "PASS":
+            errs.append(
+                f"identity A/B came back {doc.get('verdict')} "
+                f"(first_drift={doc.get('first_drift')}) — two runs of "
+                "the same seeded config must be bit-identical")
+    return errs
+
+
+def main() -> int:
+    errs = check_static()
+    if not errs:
+        errs += check_smoke()
+        errs += check_certify()
+    if errs:
+        print("parity schema/emitter DRIFT:")
+        for e in errs:
+            print(f"  - {e}")
+        return 1
+    print(f"parity gate OK: {len(parity.PARITY_FIELDS)}+"
+          f"{len(parity.VERDICT_FIELDS)} fields in sync; parity=true "
+          "smoke tapped all four seams; identity certify PASSed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

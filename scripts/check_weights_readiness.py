@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Weights-readiness CI gate: when checkpoints are present, they must
+reach the golden VALUE tier — zero value-tier families is a FAILURE.
+
+The failure mode this kills (ROADMAP 2b, VERDICT r4 #2): a weighted host
+with misplaced/broken checkpoints still passes the whole suite, because
+every golden variant silently downgrades to the shape tier. This gate
+makes that downgrade loud:
+
+  1. resolve the checkpoint directory — ``$1`` or ``VFT_WEIGHTS_DIR``;
+     a zero-egress image with no directory configured (or an empty one)
+     SKIPs with exit 0: nothing was expected, nothing is enforced;
+  2. run ``scripts/verify_weights.py`` on it (inventory + digest check +
+     transplant conversion + golden value run → ``readiness.json``);
+  3. exit 1 when ZERO families with found checkpoints reach
+     ``golden_value_pass`` — expected weights resolving to no value-tier
+     evidence means the transplant or the goldens are broken;
+  4. re-run the golden suite with ``VFT_REQUIRE_VALUE_TIER=<found
+     families>`` (``all`` when every family resolved) so any individual
+     family silently falling back to the shape tier fails the pytest
+     itself, per family, with the missing-checkpoint diagnosis
+     (tests/test_golden.py).
+
+Runs in the CI quick tier (.github/workflows/ci.yml) where it SKIPs
+today; the moment a weights cache/secret materializes a directory, the
+same wiring starts enforcing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def main() -> int:
+    arg = sys.argv[1] if len(sys.argv) > 1 else None
+    raw = arg or os.environ.get("VFT_WEIGHTS_DIR") or ""
+    if not raw:
+        print("weights-readiness SKIP: no checkpoint directory configured "
+              "(pass one or set VFT_WEIGHTS_DIR) — this zero-egress image "
+              "expects none")
+        return 0
+    directory = Path(raw)
+    if not directory.is_dir():
+        print(f"weights-readiness SKIP: {directory} is not a directory — "
+              "no checkpoints expected here")
+        return 0
+
+    from scripts.verify_weights import scan
+    found = scan(directory)
+    if not found:
+        print(f"weights-readiness SKIP: no recognized checkpoints under "
+              f"{directory} (drop .pth/.pt/.msgpack files in and re-run)")
+        return 0
+
+    # checkpoints ARE present: from here on, silence is failure
+    print(f"weights-readiness: {len(found)} checkpoint key(s) under "
+          f"{directory} — running verify_weights + golden value tier")
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "verify_weights.py"),
+         str(directory)],
+        cwd=str(REPO_ROOT))
+    rc = proc.returncode
+
+    rpath = directory / "readiness.json"
+    if not rpath.exists():
+        print("weights-readiness FAIL: verify_weights.py left no "
+              f"{rpath} behind")
+        return 1
+    readiness = json.load(open(rpath))
+    with_weights = sorted(f for f, r in readiness.items() if r["found"])
+    ready = sorted(f for f, r in readiness.items()
+                   if r.get("golden_value_pass"))
+    print(f"weights-readiness: families with checkpoints: {with_weights}; "
+          f"value-verified: {ready or 'NONE'}")
+    if not ready:
+        print("weights-readiness FAIL: expected weights resolved to ZERO "
+              "value-tier families — every golden variant silently fell "
+              "back to the shape tier (see readiness.json for per-family "
+              "convert_errors)")
+        return 1
+
+    # enforce per-family: any found family downgrading to shape tier
+    # fails its own golden variant with the diagnosis
+    require = ("all" if set(with_weights) >= set(readiness) else
+               ",".join(with_weights))
+    env = dict(os.environ, VFT_WEIGHTS_DIR=str(directory),
+               VFT_REQUIRE_VALUE_TIER=require)
+    print(f"weights-readiness: enforcing VFT_REQUIRE_VALUE_TIER={require}")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_golden.py", "-q"],
+        cwd=str(REPO_ROOT), env=env)
+    if proc.returncode:
+        print("weights-readiness FAIL: the VFT_REQUIRE_VALUE_TIER golden "
+              "run went red (a family with checkpoints shape-tiered)")
+        return 1
+    if rc:
+        print("weights-readiness FAIL: verify_weights.py exited "
+              f"{rc} (golden suite failures)")
+        return 1
+    print(f"weights-readiness OK: {len(ready)} value-verified family(ies)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -323,6 +323,76 @@ def test_mfu_regression_vs_own_history(tmp_path):
                                        AlertConfig()) == []
 
 
+def _verdict_doc(fam="raft", host="vm", bad=(), time_=NOW):
+    """A schema-valid _parity_verdict.json document with the seams in
+    ``bad`` pushed out of band (telemetry/parity.py certify shape)."""
+    from video_features_tpu.telemetry import parity
+    seams = {}
+    for seam in parity.SEAMS:
+        ok = seam not in bad
+        band = parity.tolerance_for(fam, seam)
+        seams[seam] = {"pairs": 2, "mean_abs": 0.0, "max_rel": 0.0,
+                       "max_abs": 0.0 if ok else band["max_abs"] * 5,
+                       "cos": 1.0 if ok else 0.5,
+                       "tol_max_abs": band["max_abs"],
+                       "tol_cos": band["cos"], "why": band["why"],
+                       "ok": ok, "note": None}
+    first = next((s for s in parity.SEAMS if s in bad), None)
+    return {"schema": parity.VERDICT_SCHEMA, "family": fam, "host": host,
+            "flip": "dtype=bf16", "ref": {"precision": "float32"},
+            "cand": {"precision": "bfloat16"},
+            "corpus": [{"video": "v.mp4", "sha256": None}],
+            "seams": seams, "first_drift": first,
+            "verdict": "FAIL" if first else "PASS", "time": time_}
+
+
+def test_parity_drift_scopes_per_out_of_band_seam(tmp_path):
+    from video_features_tpu.telemetry import parity
+    doc = _verdict_doc(bad=("backbone", "head"))
+    assert parity.validate_verdict(doc) == []
+    obs = dict(_obs(tmp_path), parity=[doc])
+    found = alerts._rule_parity_drift(obs, AlertConfig())
+    assert [f["scope"] for f in found] == ["vm/family=raft/seam=backbone",
+                                          "vm/family=raft/seam=head"]
+    for f in found:
+        assert f["value"] > f["threshold"]
+        assert "dtype=bf16" in f["summary"]
+    # a PASS verdict (and a missing parity section) fires nothing
+    assert alerts._rule_parity_drift(
+        dict(_obs(tmp_path), parity=[_verdict_doc()]), AlertConfig()) == []
+    assert alerts._rule_parity_drift(_obs(tmp_path), AlertConfig()) == []
+
+
+def test_parity_drift_artifact_is_the_state(tmp_path):
+    """E2E through observe_root + the engine + the report gates: a FAIL
+    verdict on disk fires parity_drift and trips --fail-on-alert; a
+    re-certify PASS overwriting it resolves and lifts the gate."""
+    from video_features_tpu import fleet_report
+    root = tmp_path / "out"
+    root.mkdir()
+    write_json_atomic(root / "_heartbeat_hostA.json",
+                      {"run_id": "r1", "host_id": "hostA",
+                       "time": time.time(), "interval_s": 2.0,
+                       "final": True})
+    write_json_atomic(root / "_parity_verdict.json",
+                      _verdict_doc(bad=("transform",), time_=time.time()))
+    assert [d["family"] for d in alerts.observe_root(root)["parity"]] == \
+        ["raft"]
+    AlertEngine(root).evaluate()
+    active = current_alerts(root)
+    assert [a["rule"] for a in active] == ["parity_drift"]
+    assert active[0]["scope"] == "vm/family=raft/seam=transform"
+    assert all(validate_alert(r) == []
+               for r in read_jsonl(root / alerts.ALERTS_FILENAME))
+    assert fleet_report.main([str(root), "--fail-on-alert"]) == 1
+    # the verdict artifact IS the episode state: a PASS re-certify ends it
+    write_json_atomic(root / "_parity_verdict.json",
+                      _verdict_doc(time_=time.time()))
+    AlertEngine(root).evaluate()
+    assert [a["rule"] for a in current_alerts(root)] == []
+    assert fleet_report.main([str(root), "--fail-on-alert"]) == 0
+
+
 # -- flight recorder ---------------------------------------------------------
 
 def _stale_root(tmp_path):

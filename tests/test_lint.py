@@ -173,6 +173,23 @@ def make_repo(tmp_path: Path) -> Path:
                        "verdict": {"enum": ["PASS", "FAIL"]}},
         "required": ["schema"], "additionalProperties": False})
 
+    _w(root, f"{pkg}/telemetry/parity.py", """\
+        SCHEMA_VERSION = "vft.parity/1"
+        VERDICT_SCHEMA = "vft.parity_verdict/1"
+        SEAMS = ("decode", "head")
+        VERDICTS = ("PASS", "FAIL")
+        PARITY_FIELDS = ("schema", "seam")
+        VERDICT_FIELDS = ("schema", "verdict")
+        """)
+    _wj(root, f"{pkg}/telemetry/parity.schema.json", {
+        "properties": {"schema": {"enum": ["vft.parity/1"]},
+                       "seam": {"enum": ["decode", "head"]}},
+        "required": ["schema"], "additionalProperties": False})
+    _wj(root, f"{pkg}/telemetry/parity_verdict.schema.json", {
+        "properties": {"schema": {"enum": ["vft.parity_verdict/1"]},
+                       "verdict": {"enum": ["PASS", "FAIL"]}},
+        "required": ["schema"], "additionalProperties": False})
+
     _w(root, f"{pkg}/telemetry/roofline.py", """\
         SCHEMA_VERSION = "vft.roofline/1"
         VERDICTS = ("compute-bound", "host-bound")

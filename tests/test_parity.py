@@ -1,0 +1,303 @@
+"""Per-seam numerics observatory (telemetry/parity.py, ISSUE 19).
+
+Contracts pinned here:
+  - seam digests carry exactly PARITY_FIELDS and validate against the
+    checked-in schema; the tolerance registry self-validates (known
+    seams, numeric bounds, written justifications, '*' defaults);
+  - ``parity=false`` is a true zero: byte-identical features, no
+    ``_parity.jsonl`` anywhere, an empty heartbeat section, and the
+    TransformTap/tap off paths are pure pass-throughs;
+  - the journal is bit-stable (modulo wall-clock fields) across
+    ``video_workers`` 1 vs 2 and across shared-decode (multi-family)
+    vs private-decode (single-family) runs — the observatory must
+    never report drift that is merely scheduling;
+  - the certify A/B attributes an injected drift to exactly the
+    perturbed seam (FAIL names the FIRST out-of-band seam), and its
+    verdict document round-trips through the checked-in schema — the
+    committed ``evidence/parity/*_bf16`` verdicts included.
+"""
+import contextlib
+import io as _io
+import json
+import shutil
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.telemetry import parity
+from video_features_tpu.telemetry.jsonl import read_jsonl
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# -- units (quick tier) -----------------------------------------------------
+
+@pytest.mark.quick
+def test_digest_seam_fields_and_schema():
+    arr = np.linspace(-2, 2, 60, dtype=np.float32).reshape(5, 12)
+    for seam in parity.SEAMS:
+        rec = parity.digest_seam(seam, "feat", arr, video="v.mp4",
+                                 feature_type="resnet", index=3)
+        assert tuple(rec) == parity.PARITY_FIELDS, seam
+        assert rec["seam"] == seam and rec["index"] == 3
+        assert rec["schema"] == parity.SCHEMA_VERSION
+        assert parity.validate_parity(rec) == []
+
+
+@pytest.mark.quick
+def test_tolerance_registry_self_validates():
+    assert parity.validate_tolerances() == []
+    # every band resolves: family-specific where declared, '*' fallback
+    for seam in parity.SEAMS:
+        band = parity.tolerance_for("nosuchfamily", seam)
+        assert band["max_abs"] > 0 and 0 < band["cos"] <= 1.0
+    raft = parity.tolerance_for("raft", "backbone")
+    assert raft["max_abs"] > parity.tolerance_for("*", "decode")["max_abs"]
+
+
+@pytest.mark.quick
+def test_tolerance_registry_rejects_corruption(monkeypatch):
+    bad = dict(parity.TOLERANCES)
+    bad[("x", "nosuchseam")] = {"max_abs": 1.0, "cos": 0.9,
+                                "why": "long enough justification here"}
+    bad[("raft", "backbone")] = {"max_abs": "big", "cos": 0.9, "why": "no"}
+    monkeypatch.setattr(parity, "TOLERANCES", bad)
+    errs = parity.validate_tolerances()
+    assert any("unknown seam" in e for e in errs)
+    assert any("is not a number" in e for e in errs)
+    assert any("written justification" in e for e in errs)
+
+
+@pytest.mark.quick
+def test_normalize_flip_pins_reference_dtype():
+    # dtype=bf16 pins f32 on the reference arm REGARDLESS of the (now
+    # flipped) YAML default — a re-certify stays meaningful post-flip
+    ref, cand = parity._normalize_flip("dtype=bf16")
+    assert ref == {"precision": "float32"}
+    assert cand == {"precision": "bfloat16"}
+    ref, cand = parity._normalize_flip("precision=float32")
+    assert cand == {"precision": "float32"}
+    with pytest.raises(SystemExit):
+        parity._normalize_flip("dtype=int8")
+
+
+@pytest.mark.quick
+def test_off_path_is_pure_passthrough():
+    assert parity.active() is None
+    assert parity.snapshot() == {}
+    # tap() with no active observer: one global read, no effect
+    parity.tap("decode", "frame", np.ones(3), video="v",
+               feature_type="resnet")
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    # identity transform: the frame object passes through untouched
+    assert parity.TransformTap(None, "v.mp4", "resnet")(x) is x
+    t = parity.TransformTap(lambda f: f * 2.0, "v.mp4", "resnet")
+    np.testing.assert_array_equal(t(x), x * 2.0)
+
+
+@pytest.mark.quick
+def test_compare_captures_names_first_drifted_seam():
+    rng = np.random.default_rng(7)
+    ref = {}
+    for i, seam in enumerate(parity.SEAMS):
+        ref[("v.mp4", seam, "frame", i)] = \
+            rng.standard_normal((4, 6)).astype(np.float64)
+    cand = {k: v.copy() for k, v in ref.items()}
+    seams, first, verdict = parity.compare_captures(ref, cand, "resnet")
+    assert (first, verdict) == (None, "PASS")
+    assert all(seams[s]["ok"] and seams[s]["max_abs"] == 0.0
+               for s in parity.SEAMS)
+
+    # drift injected past the backbone band: FAIL must name backbone,
+    # and the upstream seams must stay clean (that IS the attribution)
+    band = parity.tolerance_for("resnet", "backbone")["max_abs"]
+    k = ("v.mp4", "backbone", "frame", 2)
+    cand[k] = cand[k] + 10 * band
+    seams, first, verdict = parity.compare_captures(ref, cand, "resnet")
+    assert (first, verdict) == ("backbone", "FAIL")
+    assert not seams["backbone"]["ok"]
+    assert seams["decode"]["ok"] and seams["transform"]["ok"]
+
+    # a record-set mismatch (a seam silently losing taps) also fails
+    del cand[("v.mp4", "decode", "frame", 0)]
+    seams, first, verdict = parity.compare_captures(ref, cand, "resnet")
+    assert first == "decode" and seams["decode"]["note"]
+
+
+@pytest.mark.quick
+def test_committed_evidence_verdicts_validate():
+    """The checked-in bf16-flip evidence must stay schema-valid PASS —
+    the configs/raft.yml + pwc.yml dtype defaults cite these files."""
+    for fam in ("raft", "pwc"):
+        p = (REPO_ROOT / "evidence" / "parity" / f"{fam}_bf16"
+             / parity.VERDICT_FILENAME)
+        doc = json.loads(p.read_text())
+        assert parity.validate_verdict(doc) == [], p
+        assert doc["family"] == fam and doc["verdict"] == "PASS"
+        assert doc["flip"] == "dtype=bf16" and doc["first_drift"] is None
+        assert set(doc["seams"]) == set(parity.SEAMS)
+        # collect_verdicts must surface it (the alerts/fleet planes
+        # consume verdicts exclusively through this walk)
+        got = parity.collect_verdicts(str(p.parent))
+        assert [d["family"] for d in got] == [fam]
+
+
+# -- CLI end-to-end ---------------------------------------------------------
+
+def _run(out, tmp, vids, *extra):
+    from video_features_tpu.cli import main as cli_main
+    with contextlib.redirect_stdout(_io.StringIO()):
+        cli_main(["feature_type=resnet", "model_name=resnet18",
+                  "device=cpu", "allow_random_weights=true",
+                  "on_extraction=save_numpy", "batch_size=8",
+                  "extraction_total=4", "retry_attempts=1",
+                  f"output_path={out}", f"tmp_path={tmp}",
+                  f"video_paths=[{','.join(vids)}]", *extra])
+
+
+def _stripped(root):
+    """Sorted journal records minus the wall-clock-dependent fields —
+    the bit-stability comparison key."""
+    recs = []
+    for p in Path(root).rglob("_parity*.jsonl"):
+        recs.extend(read_jsonl(p))
+    assert all(parity.validate_parity(r) == [] for r in recs)
+    out = []
+    for r in recs:
+        r = dict(r)
+        r.pop("time", None)
+        r.pop("request_id", None)
+        out.append(json.dumps(r, sort_keys=True))
+    return sorted(out)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory, sample_video):
+    td = tmp_path_factory.mktemp("parity_corpus")
+    vids = []
+    for i in range(2):
+        dst = td / f"v_par_{i}.mp4"
+        shutil.copy(sample_video, dst)
+        vids.append(str(dst))
+    return td, vids
+
+
+@pytest.fixture(scope="module")
+def w1_run(corpus):
+    """parity=true reference run (video_workers=1), shared per-module."""
+    td, vids = corpus
+    out = td / "w1"
+    _run(out, td / "tmp", vids, "parity=true", "telemetry=true",
+         "metrics_interval_s=60", "video_workers=1")
+    return out
+
+
+# the CLI E2E arms below each pay a full extraction run; tier-1's 870s
+# budget can't absorb them, and the CI parity gate
+# (scripts/check_parity_schema.py) already proves the zero-footprint /
+# all-four-seams / identity-certify contracts on a real smoke every
+# push — the full CI tier keeps these richer matrices honest
+@pytest.mark.slow
+def test_parity_false_zero_footprint_and_byte_identity(corpus, w1_run,
+                                                       tmp_path):
+    td, vids = corpus
+    off = tmp_path / "off"
+    _run(off, td / "tmp", vids, "telemetry=true", "metrics_interval_s=60")
+    # zero footprint: no journal anywhere, empty heartbeat section
+    assert not list(off.rglob("_parity*.jsonl"))
+    hbs = list(off.rglob("_heartbeat*.json"))
+    assert hbs and json.loads(hbs[0].read_text())["parity"] == {}
+    # and the taps cost nothing observable: features byte-identical to
+    # the parity=true run
+    on_npy = sorted(p.relative_to(w1_run) for p in w1_run.rglob("*.npy"))
+    off_npy = sorted(p.relative_to(off) for p in off.rglob("*.npy"))
+    assert on_npy == off_npy and len(on_npy) == 6
+    for rel in on_npy:
+        assert (w1_run / rel).read_bytes() == (off / rel).read_bytes(), rel
+
+
+@pytest.mark.slow
+def test_journal_bit_stable_across_video_workers(corpus, w1_run, tmp_path):
+    td, vids = corpus
+    out = tmp_path / "w2"
+    _run(out, td / "tmp", vids, "parity=true", "video_workers=2")
+    ref = _stripped(w1_run)
+    assert ref and {json.loads(r)["seam"] for r in ref} == set(parity.SEAMS)
+    assert _stripped(out) == ref
+
+
+# the r21d clip-stack arm makes this the file's slowest test; tier-1's
+# 870s budget keeps it in the full CI tier (the single-family taps and
+# the workers matrix above already run in tier 1)
+@pytest.mark.slow
+def test_journal_bit_stable_shared_vs_private_decode(corpus, w1_run,
+                                                     tmp_path):
+    """A multi-family shared-decode run's resnet records must equal the
+    private-decode single-family run's — the TransformTap wraps the
+    family transform BEFORE the shared-decode subscribe, so both paths
+    tap the same tensors on the family's own thread."""
+    from video_features_tpu.cli import main as cli_main
+    td, vids = corpus
+    out = tmp_path / "multi"
+    with contextlib.redirect_stdout(_io.StringIO()):
+        cli_main(["feature_type=resnet,r21d", "device=cpu",
+                  "allow_random_weights=true", "on_extraction=save_numpy",
+                  "retry_attempts=1", "parity=true",
+                  "resnet.model_name=resnet18", "resnet.batch_size=8",
+                  "resnet.extraction_total=4", "r21d.extraction_fps=1",
+                  "r21d.stack_size=10", "r21d.step_size=10",
+                  f"output_path={out}", f"tmp_path={td / 'tmp'}",
+                  f"video_paths=[{','.join(vids)}]"])
+    all_recs = [json.loads(r) for r in _stripped(out)]
+    by_fam = {}
+    for r in all_recs:
+        by_fam.setdefault(r["feature_type"], []).append(
+            json.dumps(r, sort_keys=True))
+    # both families asked, both journaled — all four seams each
+    for fam in ("resnet", "r21d"):
+        assert {json.loads(r)["seam"] for r in by_fam[fam]} == \
+            set(parity.SEAMS), fam
+    want = [r for r in _stripped(w1_run)
+            if json.loads(r)["feature_type"] == "resnet"]
+    assert sorted(by_fam["resnet"]) == want
+
+
+@pytest.mark.slow
+def test_certify_attributes_injected_drift(corpus, tmp_path):
+    """An eps injected at the transform tap must FAIL at exactly that
+    seam — decode (upstream) clean, the verdict file schema-valid."""
+    td, vids = corpus
+    with contextlib.redirect_stdout(_io.StringIO()):
+        doc = parity.certify("resnet", flip=None, videos=[vids[0]],
+                             frames=4, out_dir=str(tmp_path),
+                             perturb={"transform": 0.05})
+    assert doc["verdict"] == "FAIL"
+    assert doc["first_drift"] == "transform"
+    assert doc["seams"]["decode"]["ok"]
+    assert not doc["seams"]["transform"]["ok"]
+    on_disk = json.loads(
+        (tmp_path / parity.VERDICT_FILENAME).read_text())
+    assert parity.validate_verdict(on_disk) == []
+    assert on_disk["verdict"] == "FAIL"
+    # the report/validate surface consumes it the same way
+    assert parity.collect_verdicts(str(tmp_path))[0]["first_drift"] == \
+        "transform"
+
+
+# the CI quick gate (scripts/check_parity_schema.py check_certify) runs
+# this same identity A/B on every push; tier 1 doesn't need to pay for
+# it twice
+@pytest.mark.slow
+def test_certify_identity_is_bit_exact(corpus, tmp_path):
+    """Two arms of the same seeded config are BIT-identical — the
+    harness itself contributes zero error (this is what makes a PASS
+    verdict evidence about the flip, not about the harness)."""
+    td, vids = corpus
+    with contextlib.redirect_stdout(_io.StringIO()):
+        doc = parity.certify("resnet", flip=None, videos=[vids[0]],
+                             frames=4, out_dir=str(tmp_path))
+    assert doc["verdict"] == "PASS" and doc["first_drift"] is None
+    for seam in parity.SEAMS:
+        m = doc["seams"][seam]
+        assert m["ok"] and m["max_abs"] == 0.0 and m["cos"] == 1.0, seam

@@ -77,8 +77,8 @@ NON_SEMANTIC_KEYS = frozenset({
     "fanout_depth", "cross_video_batching", "clip_batch_size",
     "batch_size", "flow_stack_batch", "model_parallel",
     "mesh_devices", "distributed",
-    "telemetry", "metrics_interval_s", "trace", "health", "roofline",
-    "history", "alerts",
+    "telemetry", "metrics_interval_s", "trace", "health", "parity",
+    "roofline", "history", "alerts",
     "profile", "profile_trace_dir", "compilation_cache_dir",
     "retry_attempts", "retry_backoff_s", "video_deadline_s",
     "retry_failed",

@@ -127,6 +127,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         # script). Exits with the lint verdict.
         from .lint.engine import main as lint_main
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "parity":
+        # numerics observatory: `python main.py parity <run_dir>` renders
+        # a run's _parity.jsonl; `python main.py parity certify --config
+        # raft.yml --flip dtype=bf16` A/B-certifies a precision flip
+        # with per-seam error attribution (telemetry/parity.py; also
+        # installed as the `vft-parity` console script, docs/numerics.md)
+        from .telemetry.parity import main as parity_main
+        raise SystemExit(parity_main(argv[1:]))
     if argv and argv[0] == "warmup":
         # ahead-of-time compile warmup: `python main.py warmup resnet ...`
         # routes to the store populator (compile_cache.py; also installed
@@ -376,6 +384,20 @@ def main(argv: Optional[List[str]] = None) -> None:
             host_id=(recorder.host_id if fleet_mode == "queue"
                      and recorder is not None else None)).start()
 
+    # Parity observatory (parity=true, telemetry/parity.py): per-seam
+    # numerics digests (decode -> transform -> backbone -> head) appended
+    # to {out_root}/_parity.jsonl (per-host in fleet=queue dirs, like
+    # traces). Off by default: every tap is one module-global read, and
+    # the transform-seam wrapper is never even installed.
+    parity_observer = None
+    if bool(args.get("parity", False)):
+        from .telemetry import parity as parity_mod
+        parity_observer = parity_mod.ParityObserver(
+            out_root,
+            host_id=(recorder.host_id if fleet_mode == "queue"
+                     and recorder is not None else None))
+        parity_mod._set_active(parity_observer)
+
     # Work-stealing fleet queue (fleet=queue, parallel/queue.py): instead
     # of owning a fixed hash shard, this host claims videos one at a time
     # from the shared {out_root}/_queue/ by atomic rename, renews its
@@ -571,6 +593,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             # likewise in the finally: an aborted run's partial timeline is
             # still a complete, loadable trace file (atomic temp+rename)
             tracer.close()
+        if parity_observer is not None:
+            # appends are already durable (O_APPEND); close just detaches
+            # the module global so in-process callers don't inherit taps
+            from .telemetry import parity as parity_mod
+            if parity_mod.active() is parity_observer:
+                parity_mod._set_active(None)
+            parity_observer.close()
         if inject_plan is not None:
             # the chaos run's record of exactly what it injected (the
             # counters land in the manifest metrics dump too)
@@ -641,6 +670,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     if rf_observer is not None:
         print(f"roofline: {rf_observer.path} (render with vft-roofline "
               f"{out_root})")
+    if parity_observer is not None:
+        print(f"parity: per-seam numerics digests in {parity_observer.path} "
+              f"(render with vft-parity {out_root}; certify flips with "
+              "vft-parity certify)")
     if health_on:
         from .telemetry.health import HEALTH_FILENAME
         print(f"health: per-(video, family) feature digests in "

@@ -381,6 +381,11 @@ def sanity_check(args: Config, *, require_videos: bool = True) -> None:
         raise ValueError(f"health={he!r}: expected true or false (digests "
                          "features into {output_path}/_health.jsonl and "
                          "quarantines NaN/Inf outputs, telemetry/health.py)")
+    pa = args.get("parity", False)
+    if not isinstance(pa, bool):
+        raise ValueError(f"parity={pa!r}: expected true or false (per-seam "
+                         "numerics digests into {output_path}/_parity.jsonl, "
+                         "telemetry/parity.py — render with vft-parity)")
     rf = args.get("roofline", False)
     if not isinstance(rf, bool):
         raise ValueError(f"roofline={rf!r}: expected true or false (MFU "

@@ -41,6 +41,13 @@ class BaseExtractor:
         # to write NaN/Inf (routed through the faults taxonomy as POISON).
         # Off by default; the disabled cost is this one attribute read.
         self.health = bool(args.get("health", False))
+        # parity=true (telemetry/parity.py): per-seam numerics digests
+        # (decode -> transform -> backbone -> head) into
+        # {output_path}/_parity.jsonl. Off by default; taps are only
+        # installed when this attribute is set, so the off path is
+        # byte-identical (no transform wrapper, no per-batch branch
+        # beyond this one attribute read).
+        self.parity = bool(args.get("parity", False))
         # cache=true (cache.py): content-addressed feature cache keyed on
         # (input sha256, resolved-config fingerprint, weights sha). The
         # weights capture must start BEFORE the subclass __init__ resolves
@@ -107,6 +114,17 @@ class BaseExtractor:
         through to a private source below — isolation over sharing."""
         from ..parallel import fanout
         from ..utils import faults
+        if self.parity:
+            # parity taps the decode and transform seams by wrapping the
+            # host transform BEFORE the shared-decode subscribe, so the
+            # shared and private paths digest the same tensors on this
+            # family's own thread. Only installed when parity=true: a
+            # wrapper is never None, and utils/io.py sizes parallel
+            # decode queues on `transform is not None` — the off path
+            # must stay byte-identical.
+            from ..telemetry import parity as _parity
+            kwargs["transform"] = _parity.TransformTap(
+                kwargs.get("transform"), str(video_path), self.feature_type)
         session = fanout.current_session()
         if session is not None:
             sub = session.subscribe(self.feature_type, **kwargs)
@@ -322,6 +340,14 @@ class BaseExtractor:
             with profiler.stage("health"):
                 health.check_features(feats, video_path, self.feature_type,
                                       self.output_path)
+        if self.parity:
+            # head seam: the per-key feature tensors exactly as the sink
+            # is about to persist them (certify's in-process arms tap
+            # this seam themselves off the extract() return)
+            from ..telemetry import parity as _parity
+            for key in sorted(feats):
+                _parity.tap("head", key, feats[key], video=str(video_path),
+                            feature_type=self.feature_type)
         # re-check before overwrite: another worker may have just written it
         # (reference base_extractor.py:72-76)
         if self.on_extraction != "print" and sinks.is_already_exist(

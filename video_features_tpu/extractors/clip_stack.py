@@ -178,7 +178,13 @@ class ClipStackExtractor(BaseExtractor):
                 flush()
         if stacks:
             flush()
-        for feats in stream.finish():
+        for bi, feats in enumerate(stream.finish()):
+            if self.parity:
+                # backbone seam: per-group clip activations off the device
+                from ..telemetry import parity as _parity
+                _parity.tap("backbone", self.feature_type, feats,
+                            video=str(src.path),
+                            feature_type=self.feature_type, index=bi)
             vid_feats.extend(list(feats))
         return {self.feature_type: np.array(vid_feats)}
 
@@ -196,7 +202,14 @@ class ClipStackExtractor(BaseExtractor):
         except BaseException:
             packer.abort_video(handle)
             raise
-        return {self.feature_type: packer.close_video(handle)}
+        feats = packer.close_video(handle)
+        if self.parity:
+            # backbone seam: the packer returns this video's clips in
+            # order as one array — a single index-0 record per video
+            from ..telemetry import parity as _parity
+            _parity.tap("backbone", self.feature_type, feats,
+                        video=str(src.path), feature_type=self.feature_type)
+        return {self.feature_type: feats}
 
     def _make_stream(self):
         return self.feature_stream(

@@ -144,7 +144,15 @@ class OpticalFlowExtractor(BaseExtractor):
             timestamps_ms.extend(ts if first else ts[1:])
             first = False
         if stream is not None:
-            for flows in stream.finish():  # (n-1, H, W, 2) float32 per batch
+            for bi, flows in enumerate(stream.finish()):
+                # (n-1, H, W, 2) float32 per batch
+                if self.parity:
+                    # backbone seam: the raw per-batch flow field off the
+                    # device, before the (0,3,1,2) sink transpose
+                    from ..telemetry import parity as _parity
+                    _parity.tap("backbone", self.feature_type, flows,
+                                video=str(video_path),
+                                feature_type=self.feature_type, index=bi)
                 vid_feats.extend(list(flows.transpose(0, 3, 1, 2)))
         return {
             self.feature_type: np.array(vid_feats),

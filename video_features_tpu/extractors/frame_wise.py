@@ -179,7 +179,14 @@ class FrameWiseExtractor(BaseExtractor):
             stream.submit(np.stack(batch))
             timestamps_ms.extend(times)
         if stream is not None:
-            for feats in stream.finish():
+            for bi, feats in enumerate(stream.finish()):
+                if self.parity:
+                    # backbone seam: the per-batch activations exactly as
+                    # they come off the device runner
+                    from ..telemetry import parity as _parity
+                    _parity.tap("backbone", self.feature_type, feats,
+                                video=str(video_path),
+                                feature_type=self.feature_type, index=bi)
                 vid_feats.extend(list(feats))
         return {
             self.feature_type: np.array(vid_feats),

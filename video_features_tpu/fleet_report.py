@@ -430,7 +430,20 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
         # recorded traffic drills (loadgen.py): each _scenario.json
         # verdict with its windowed SLO-attainment curve
         "scenarios": collect_scenarios(root),
+        # certify verdict artifacts (telemetry/parity.py): per-seam
+        # numerics error attribution, rendered as == parity == and
+        # exported as vft_parity_* gauges; the parity_drift alert rule
+        # reads the same collection
+        "parity": _parity_verdicts(root),
     }
+
+
+def _parity_verdicts(root: str) -> List[dict]:
+    try:
+        from .telemetry.parity import collect_verdicts
+        return collect_verdicts(str(root))
+    except Exception:
+        return []
 
 
 def _roofline_rollup(root: str) -> Optional[dict]:
@@ -849,7 +862,33 @@ def render(agg: dict, capacity: Optional[dict] = None) -> List[str]:
             lines.append(line)
     for sc in agg.get("scenarios") or []:
         lines += render_scenario(sc)
+    for pv in agg.get("parity") or []:
+        lines += render_parity(pv)
     return lines
+
+
+def render_parity(pv: dict) -> List[str]:
+    """The ``== parity ==`` block for one certify verdict: the flip
+    under certification, PASS/FAIL, and one max_abs/band + cos/floor
+    entry per seam in pipeline order — a FAIL leads with the first
+    drifted seam, the attribution the observatory exists for."""
+    from .telemetry.parity import SEAMS
+    head = (f"== parity ==  {pv.get('family')}"
+            + (f" flip={pv.get('flip')}" if pv.get("flip") else "")
+            + f": {pv.get('verdict')}")
+    if pv.get("first_drift"):
+        head += f"  first_drift={pv['first_drift']}"
+    parts = []
+    for seam in SEAMS:
+        m = (pv.get("seams") or {}).get(seam)
+        if not isinstance(m, dict):
+            continue
+        mark = "" if m.get("ok") else "!"
+        parts.append(f"{mark}{seam}={m.get('max_abs')}/"
+                     f"{m.get('tol_max_abs')}")
+    if parts:
+        head += "  " + " ".join(parts)
+    return [head + "  (vft-parity for the full table)"]
 
 
 _SPARK = "▁▂▃▄▅▆▇█"
@@ -1004,6 +1043,15 @@ def build_prom_dump(agg: dict, capacity: Optional[dict] = None) -> dict:
         for t, tb in sorted((sc.get("tenants") or {}).items()):
             g("vft_scenario_attainment_pct", tb.get("attainment_pct"),
               scenario=name, tenant=t)
+    for pv in agg.get("parity") or []:
+        fam = pv.get("family")
+        flip = pv.get("flip") or "none"
+        g("vft_parity_verdict_pass",
+          1 if pv.get("verdict") == "PASS" else 0, family=fam, flip=flip)
+        for seam, m in sorted((pv.get("seams") or {}).items()):
+            if isinstance(m, dict):
+                g("vft_parity_seam_error", m.get("max_abs"),
+                  family=fam, seam=seam)
     if agg.get("alerts"):
         # ALERTS{alertname, alertstate, severity, scope} 1 — the exact
         # series shape Prometheus-native alert evaluators export, so

@@ -733,6 +733,23 @@ def check_schema_lockstep(ctx: LintContext) -> List[Finding]:
         "SCENARIO_FIELDS", ctx.line_of(rel, "SCENARIO_FIELDS = "),
         {"verdict": "VERDICTS"})
 
+    # parity <-> parity.schema.json + parity_verdict.schema.json
+    # (two record shapes, one emitter module — the per-seam digest
+    # journal and the certify verdict artifact; the verdict schema's
+    # version tag lives in VERDICT_SCHEMA, not SCHEMA_VERSION)
+    rel = tel + "parity.py"
+    consts, _ = consts_of(rel)
+    findings += _schema_checks(
+        ctx, "parity", ctx.load_json(tel + "parity.schema.json"),
+        rel, consts, "PARITY_FIELDS",
+        ctx.line_of(rel, "PARITY_FIELDS = "), {"seam": "SEAMS"})
+    findings += _schema_checks(
+        ctx, "parity_verdict",
+        ctx.load_json(tel + "parity_verdict.schema.json"),
+        rel, dict(consts, SCHEMA_VERSION=consts.get("VERDICT_SCHEMA")),
+        "VERDICT_FIELDS", ctx.line_of(rel, "VERDICT_FIELDS = "),
+        {"verdict": "VERDICTS"})
+
     # roofline <-> roofline.schema.json (nested)
     rel = tel + "roofline.py"
     consts, _ = consts_of(rel)

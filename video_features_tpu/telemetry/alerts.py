@@ -453,6 +453,37 @@ def _rule_disk_pressure(obs: dict, cfg: AlertConfig) -> List[dict]:
     return out
 
 
+def _rule_parity_drift(obs: dict, cfg: AlertConfig) -> List[dict]:
+    """Per-seam numerics drift off the certify verdict artifacts
+    (telemetry/parity.py ``_parity_verdict.json``, collected by
+    ``observe_root``): one finding per out-of-band seam, scoped
+    ``{host}/family={f}/seam={s}`` so the page names WHERE the numerics
+    went, not just that they did. The episode clears when a re-certify
+    PASS overwrites the verdict — the artifact is the state."""
+    from . import parity
+    out: List[dict] = []
+    for doc in obs.get("parity") or []:
+        fam = str(doc.get("family") or "?")
+        host = str(doc.get("host") or "?")
+        seams = doc.get("seams") or {}
+        for seam in parity.SEAMS:
+            m = seams.get(seam)
+            if not isinstance(m, dict) or m.get("ok", True):
+                continue
+            note = m.get("note")
+            out.append(_finding(
+                f"{host}/family={fam}/seam={seam}",
+                (f"parity drift at the {seam} seam"
+                 + (f" ({note})" if note else
+                    f": max_abs={m.get('max_abs')} vs band "
+                    f"{m.get('tol_max_abs')}, cos={m.get('cos')} vs floor "
+                    f"{m.get('tol_cos')}")
+                 + (f" — flip {doc.get('flip')}" if doc.get("flip")
+                    else "")),
+                value=m.get("max_abs"), threshold=m.get("tol_max_abs")))
+    return out
+
+
 BUILTIN_RULES: Tuple[AlertRule, ...] = (
     AlertRule("slo_burn_rate", "page",
               "multi-window serve SLO burn over the error budget",
@@ -489,6 +520,10 @@ BUILTIN_RULES: Tuple[AlertRule, ...] = (
               "storage usage at the quota level, or growth projecting "
               "it full within the horizon",
               _rule_disk_pressure),
+    AlertRule("parity_drift", "page",
+              "certified per-seam numerics error outside its tolerance "
+              "band",
+              _rule_parity_drift),
 )
 
 
@@ -533,6 +568,7 @@ def observe_root(root: str, now: Optional[float] = None) -> dict:
     lighter than ``fleet_report.aggregate`` (no span/roofline sweeps):
     this runs on every heartbeat tick of every alerting host."""
     from ..fleet_report import _queue_counts, collect_heartbeats
+    from . import parity
     now = time.time() if now is None else float(now)
     entries = collect_heartbeats(str(root), now=now)
     claims, tracked = _claims_by_host(root)
@@ -548,6 +584,9 @@ def observe_root(root: str, now: Optional[float] = None) -> dict:
         "claims": claims,
         "claims_tracked": tracked,
         "history": history.read_history(str(root)),
+        # certify verdict artifacts (telemetry/parity.py): the
+        # parity_drift rule reads per-seam ok flags off these
+        "parity": parity.collect_verdicts(str(root)),
     }
 
 

@@ -134,6 +134,11 @@ METRICS = {
     "vft_scenario_shed": "gauge",
     "vft_scenario_attainment_pct": "gauge",
 
+    # -- parity observatory (telemetry/parity.py; vft-fleet == parity ==) ---
+    "vft_parity_records_total": "counter",
+    "vft_parity_seam_error": "gauge",
+    "vft_parity_verdict_pass": "gauge",
+
     # -- roofline observatory (telemetry/roofline.py via vft-fleet) ---------
     "vft_roofline_mfu": "gauge",
     "vft_roofline_effective_tflops": "gauge",

@@ -319,6 +319,10 @@ class TelemetryRecorder:
             # effective TFLOPS / MFU / verdict, live — {} when
             # roofline=false, so the off-path heartbeat stays constant
             "roofline": self.roofline_snapshot(),
+            # parity observatory (telemetry/parity.py): per-seam digest
+            # tallies, live — {} when parity=false, so the off-path
+            # heartbeat stays constant
+            "parity": self.parity_snapshot(),
         }
         for name, fn in list(self.extra_sections.items()):
             try:
@@ -377,6 +381,17 @@ class TelemetryRecorder:
         try:
             from . import roofline
             return roofline.snapshot()
+        except Exception:
+            return {}
+
+    def parity_snapshot(self) -> dict:
+        """The active parity observer's per-seam record tallies
+        (telemetry/parity.py snapshot), ``{}`` when parity=false — the
+        recorder reads the process-global subsystem rather than owning
+        it, exactly like roofline."""
+        try:
+            from . import parity
+            return parity.snapshot()
         except Exception:
             return {}
 
